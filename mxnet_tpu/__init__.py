@@ -1,0 +1,14 @@
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from .attribute import AttrScope
+from .name import NameManager, Prefix
+from . import random
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from .executor import Executor

@@ -1,0 +1,125 @@
+"""Foundation utilities: errors, env-var config registry, dtype helpers.
+
+TPU-native equivalent of the reference's dmlc-core portability layer
+(logging / GetEnv / Parameter<T>) consumed throughout
+/root/reference/src (e.g. src/engine/threaded_engine_perdevice.cc:34-46).
+Here the config surface is a single typed env registry; per-op params live
+in ops/param.py.
+"""
+from __future__ import annotations
+
+import os
+import logging
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "MXNetError",
+    "env",
+    "register_env",
+    "list_env",
+    "string_types",
+    "numeric_types",
+    "mx_real_t",
+    "mx_uint",
+    "_Null",
+]
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+mx_real_t = np.float32
+mx_uint = int
+
+
+class _NullType:
+    """Placeholder for unset keyword arguments (mirrors mxnet.base._Null)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "_Null"
+
+    def __bool__(self):
+        return False
+
+
+_Null = _NullType()
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (parity with mxnet.base.MXNetError)."""
+
+
+# ---------------------------------------------------------------------------
+# Env-var config registry — the runtime config mechanism for the core, the
+# analogue of dmlc::GetEnv usage cataloged in
+# /root/reference/docs/how_to/env_var.md:1-100.
+# ---------------------------------------------------------------------------
+
+_ENV_REGISTRY: Dict[str, Dict[str, Any]] = {}
+
+
+def register_env(name: str, default: Any, typ: Callable = str, doc: str = "") -> None:
+    _ENV_REGISTRY[name] = {"default": default, "type": typ, "doc": doc}
+
+
+def env(name: str, default: Optional[Any] = None, typ: Optional[Callable] = None) -> Any:
+    """Read a typed environment variable, falling back to registered default."""
+    spec = _ENV_REGISTRY.get(name)
+    if spec is not None:
+        if default is None:
+            default = spec["default"]
+        if typ is None:
+            typ = spec["type"]
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if typ is None or typ is str:
+        return raw
+    if typ is bool:
+        return raw.lower() not in ("0", "false", "")
+    return typ(raw)
+
+
+def list_env() -> Dict[str, Dict[str, Any]]:
+    return dict(_ENV_REGISTRY)
+
+
+# Canonical runtime knobs (docs/how_to/env_var.md parity, TPU semantics).
+register_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice", str,
+             "Engine facade mode: ThreadedEnginePerDevice (async JAX dispatch) "
+             "or NaiveEngine (synchronous, blocks after every op; debug).")
+register_env("MXNET_EXEC_BULK_EXEC_INFERENCE", 1, int,
+             "Jit whole inference graphs (XLA fusion analogue of bulk-exec).")
+register_env("MXNET_EXEC_BULK_EXEC_TRAIN", 1, int,
+             "Jit whole training step.")
+register_env("MXNET_BACKWARD_DO_MIRROR", 0, int,
+             "Enable rematerialisation (jax.checkpoint) in the backward pass.")
+register_env("MXNET_PROFILER_AUTOSTART", 0, int, "Start profiler at import.")
+register_env("MXNET_PROFILER_MODE", 0, int, "0: symbolic only, 1: all ops.")
+register_env("MXNET_CPU_WORKER_NTHREADS", 1, int, "Host worker threads for IO.")
+register_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000 * 1000, int,
+             "Threshold above which a kvstore value is sharded across servers.")
+register_env("MXNET_DEFAULT_DTYPE", "float32", str,
+             "Default array dtype; set bfloat16 for TPU-preferred compute.")
+
+
+_LOGGER = None
+
+
+def get_logger() -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        _LOGGER = logging.getLogger("mxnet_tpu")
+    return _LOGGER
+
+
+def check_call(ret: Any) -> Any:
+    """Parity shim for mxnet.base.check_call — errors raise MXNetError directly."""
+    return ret

@@ -1,0 +1,126 @@
+"""Device context — maps the reference's Context (include/mxnet/base.h:124-196)
+onto JAX devices.
+
+Device types: ``cpu``, ``tpu``, and ``gpu`` as an alias of ``tpu`` so reference
+training scripts (``--gpus 0,1``) run unchanged.  ``cpu_pinned`` maps to host
+memory.  A Context is hashable, usable as a ``with``-scope (current-context
+stack, parity with python/mxnet/context.py), and resolves lazily to a concrete
+``jax.Device`` so contexts can be constructed before backends initialise.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus", "num_tpus"]
+
+
+class Context:
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
+
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            if device_type not in self.devstr2type:
+                raise ValueError("unknown device type %s" % device_type)
+            self.device_typeid = self.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx: Optional[Context] = None
+
+    @property
+    def device_type(self) -> str:
+        return self.devtype2str[self.device_typeid]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    def __str__(self):
+        return self.__repr__()
+
+    # -- JAX resolution ----------------------------------------------------
+    def jax_device(self):
+        """Resolve to a concrete jax.Device.
+
+        ``gpu``/``tpu`` resolve to accelerator devices (whatever platform JAX
+        exposes — TPU in production, host CPU devices in tests running under
+        ``--xla_force_host_platform_device_count``); ``cpu``/``cpu_pinned``
+        prefer the CPU backend when present.
+        """
+        import jax
+
+        if self.device_type in ("cpu", "cpu_pinned"):
+            try:
+                devs = jax.local_devices(backend="cpu")
+            except RuntimeError:
+                devs = jax.local_devices()
+        else:
+            devs = jax.local_devices()
+        return devs[self.device_id % len(devs)]
+
+    # -- with-scope --------------------------------------------------------
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "value"):
+            Context._default_ctx.value = Context("cpu", 0)
+        self._old_ctx = Context._default_ctx.value
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        Context._default_ctx.value = self._old_ctx
+
+    def empty_cache(self):
+        """Parity no-op: XLA owns the device allocator (reference:
+        src/storage/pooled_storage_manager.h ReleaseAll)."""
+
+    @classmethod
+    def default_ctx(cls) -> "Context":
+        if not hasattr(cls._default_ctx, "value"):
+            cls._default_ctx.value = Context("cpu", 0)
+        return cls._default_ctx.value
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Alias of the accelerator device so `--gpus` flags keep working on TPU."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def current_context() -> Context:
+    return Context.default_ctx()
+
+
+def num_gpus() -> int:
+    return num_tpus()
+
+
+def num_tpus() -> int:
+    import jax
+
+    try:
+        return len([d for d in jax.local_devices() if d.platform != "cpu"]) or len(
+            jax.local_devices()
+        )
+    except RuntimeError:
+        return 0

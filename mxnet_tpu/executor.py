@@ -1,0 +1,382 @@
+"""Executor — binds a Symbol to devices and runs it.
+
+TPU-native redesign of the reference GraphExecutor
+(/root/reference/src/executor/graph_executor.cc:322-676 and
+include/mxnet/executor.h).  Where the reference runs nnvm passes (Gradient,
+PlanMemory, AttachOpExecs) and pushes one engine op per node, here the whole
+graph lowers to ONE pure JAX function that XLA fuses and schedules — the
+"bulk exec" of the reference (InitOpSegs, graph_executor.cc:678) taken to its
+logical conclusion.  Autodiff (the Gradient pass + ``_backward_*`` ops) is
+``jax.vjp``; memory planning/in-place sharing is XLA buffer assignment +
+donation; ``MXNET_BACKWARD_DO_MIRROR`` maps to ``jax.checkpoint``.
+
+Semantics kept from the reference:
+  * ``grad_req`` in {write, add, null} per argument (kAddTo accumulation —
+    the DetectInplaceAddTo pass — is functional accumulation here),
+  * auxiliary states (BatchNorm moving stats) updated on training forward,
+  * ``backward(out_grads)`` head gradients; loss ops ignore them via their
+    custom vjps,
+  * monitor callback surface (SetMonitorCallback, graph_executor.cc:69).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError, env
+from .context import Context
+from .ops import OpContext
+from . import random as _random
+
+__all__ = ["Executor"]
+
+
+class _GraphPlan:
+    """Static lowering plan for a symbol: topo order, entry wiring, aux and
+    stochastic bookkeeping.  Shared across executors binding the same symbol
+    object (the analogue of shared_exec memory sharing in bucketing)."""
+
+    def __init__(self, symbol):
+        from .symbol import _topo_sort
+
+        self.symbol = symbol
+        self.nodes = _topo_sort(symbol._outputs)
+        self.arg_names = [n.name for n in self.nodes if n.is_variable]
+        self.aux_names: List[str] = []
+        for n in self.nodes:
+            self.aux_names.extend(n.aux_names())
+        self.stochastic_nodes = [
+            n for n in self.nodes if n.op is not None and n.op.stochastic]
+        self.output_entries = [(id(node), idx) for node, idx in symbol._outputs]
+        self.output_names = symbol.list_outputs()
+
+    def run(self, args: Dict[str, Any], aux: Dict[str, Any], rng,
+            is_train: bool, want_internals: bool = False):
+        """Execute the graph as a pure function of (args, aux, rng)."""
+        import jax
+
+        vals: Dict[tuple, Any] = {}
+        new_aux: Dict[str, Any] = {}
+        n_st = len(self.stochastic_nodes)
+        keys = {}
+        if n_st and rng is not None:
+            subkeys = jax.random.split(rng, n_st)
+            keys = {id(n): subkeys[i] for i, n in enumerate(self.stochastic_nodes)}
+        for n in self.nodes:
+            if n.is_variable:
+                if n.name not in args:
+                    raise MXNetError("missing argument %r" % n.name)
+                vals[(id(n), 0)] = args[n.name]
+                continue
+            ins = [vals[(id(p), idx)] for p, idx in n.inputs]
+            aux_in = tuple(aux[a] for a in n.aux_names())
+            opctx = OpContext(is_train=is_train, rng=keys.get(id(n)))
+            outs, aux_out = n.op.apply(opctx, n.attrs, ins, aux_in)
+            for i, o in enumerate(outs):
+                vals[(id(n), i)] = o
+            for aname, a in zip(n.aux_names(), aux_out):
+                new_aux[aname] = a
+        outputs = [vals[e] for e in self.output_entries]
+        if want_internals:
+            internals = {}
+            for n in self.nodes:
+                if n.is_variable:
+                    continue
+                for i in range(n.num_outputs()):
+                    oname = n.op.output_names(n.attrs, n.name)[i]
+                    internals[oname] = vals[(id(n), i)]
+            return outputs, new_aux, internals
+        return outputs, new_aux
+
+
+class Executor:
+    def __init__(self, symbol, ctx: Context, args, args_grad=None,
+                 grad_req="write", aux_states=None, group2ctx=None,
+                 shared_exec: Optional["Executor"] = None):
+        from . import ndarray as nd
+
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self._group2ctx = group2ctx or {}
+        if shared_exec is not None and shared_exec._symbol is symbol:
+            self._plan = shared_exec._plan
+        else:
+            self._plan = _GraphPlan(symbol)
+        plan = self._plan
+
+        # ---- arguments -------------------------------------------------
+        if isinstance(args, dict):
+            self.arg_dict = {k: self._as_nd(v) for k, v in args.items()}
+            missing = [a for a in plan.arg_names if a not in self.arg_dict]
+            if missing:
+                raise MXNetError("bind missing arguments: %s" % missing)
+        else:
+            args = list(args)
+            if len(args) != len(plan.arg_names):
+                raise MXNetError(
+                    "bind expects %d args, got %d" % (len(plan.arg_names), len(args)))
+            self.arg_dict = {n: self._as_nd(a) for n, a in zip(plan.arg_names, args)}
+        self.arg_arrays = [self.arg_dict[n] for n in plan.arg_names]
+
+        # ---- gradients -------------------------------------------------
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in plan.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(plan.arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null") for n in plan.arg_names}
+        # inputs an op declares non-differentiable (labels, indices)
+        for n in plan.nodes:
+            if n.is_variable or not n.op.no_grad_inputs:
+                continue
+            in_names = n.op.input_names(n.attrs)
+            for iname, (p, _) in zip(in_names, n.inputs):
+                if iname in n.op.no_grad_inputs and p.is_variable:
+                    self._grad_req[p.name] = "null"
+        if args_grad is None:
+            self.grad_dict = {}
+        elif isinstance(args_grad, dict):
+            self.grad_dict = {k: self._as_nd(v) for k, v in args_grad.items()}
+        else:
+            self.grad_dict = {
+                n: self._as_nd(g) for n, g in zip(plan.arg_names, args_grad)
+                if g is not None}
+        for name in list(self.grad_dict):
+            if self._grad_req.get(name, "null") == "null":
+                del self.grad_dict[name]
+        self.grad_arrays = [self.grad_dict.get(n) for n in plan.arg_names]
+
+        # ---- aux states ------------------------------------------------
+        if aux_states is None:
+            aux_states = []
+        if isinstance(aux_states, dict):
+            self.aux_dict = {k: self._as_nd(v) for k, v in aux_states.items()}
+        else:
+            aux_states = list(aux_states)
+            self.aux_dict = {n: self._as_nd(a)
+                             for n, a in zip(plan.aux_names, aux_states)}
+        for aname in plan.aux_names:
+            if aname not in self.aux_dict:
+                raise MXNetError("bind missing auxiliary state %r" % aname)
+        self.aux_arrays = [self.aux_dict[n] for n in plan.aux_names]
+
+        self._output_arrays: List = []
+        self._monitor_callback = None
+        self._jit_cache: Dict[Any, Any] = {}
+        # NaiveEngine parity: MXNET_ENGINE_TYPE=NaiveEngine disables jit and
+        # synchronizes after every call (threaded_engine.h:329-337 debugging).
+        self._naive = env("MXNET_ENGINE_TYPE") == "NaiveEngine"
+
+    # ------------------------------------------------------------------
+    def _as_nd(self, v):
+        from . import ndarray as nd
+
+        if isinstance(v, nd.NDArray):
+            return v
+        return nd.array(v, self._ctx)
+
+    @property
+    def outputs(self) -> List:
+        return self._output_arrays
+
+    @property
+    def output_dict(self) -> Dict[str, Any]:
+        return dict(zip(self._plan.output_names, self._output_arrays))
+
+    # ------------------------------------------------------------------
+    # compiled callables
+    # ------------------------------------------------------------------
+    def _get_fwd(self, is_train: bool, internals: bool = False):
+        import jax
+
+        key = ("fwd", is_train, internals)
+        if key not in self._jit_cache:
+            plan = self._plan
+
+            def fn(args, aux, rng):
+                return plan.run(args, aux, rng, is_train, want_internals=internals)
+
+            self._jit_cache[key] = fn if self._naive else jax.jit(fn)
+        return self._jit_cache[key]
+
+    def _get_fwd_bwd(self, is_train: bool, diff_names: tuple, add_names: tuple):
+        import jax
+
+        key = ("fwdbwd", is_train, diff_names, add_names)
+        if key not in self._jit_cache:
+            plan = self._plan
+            remat = bool(env("MXNET_BACKWARD_DO_MIRROR", 0, int))
+
+            def fn(diff_args, other_args, aux, rng, out_grads, old_grads):
+                def f(d):
+                    merged = dict(other_args)
+                    merged.update(d)
+                    outs, new_aux = plan.run(merged, aux, rng, is_train)
+                    return tuple(outs), new_aux
+
+                f2 = jax.checkpoint(f) if remat else f
+                primals, vjp_fn = jax.vjp(f2, diff_args)
+                outs, new_aux = primals
+                cts = tuple(
+                    og if og is not None else jax.numpy.ones_like(o)
+                    for o, og in zip(outs, out_grads))
+                (grads,) = vjp_fn((cts, jax.tree_util.tree_map(
+                    jax.numpy.zeros_like, new_aux)))
+                for name in add_names:
+                    grads[name] = grads[name] + old_grads[name]
+                return list(outs), new_aux, grads
+
+            self._jit_cache[key] = fn if self._naive else jax.jit(fn)
+        return self._jit_cache[key]
+
+    # ------------------------------------------------------------------
+    # execution API
+    # ------------------------------------------------------------------
+    def forward(self, is_train: bool = False, **kwargs):
+        from . import ndarray as nd
+
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown forward argument %r" % k)
+            self.arg_dict[k][:] = v if not isinstance(v, np.ndarray) else \
+                nd.array(v, self._ctx)
+        args = {k: v._data for k, v in self.arg_dict.items()}
+        aux = {k: v._data for k, v in self.aux_dict.items()}
+        rng = _random.next_key() if self._plan.stochastic_nodes else None
+        self._last_rng = rng
+        if self._monitor_callback is not None:
+            outs, new_aux, internals = self._get_fwd(is_train, True)(args, aux, rng)
+            for name, arr in internals.items():
+                self._monitor_callback(name, nd.NDArray(arr, self._ctx))
+        else:
+            outs, new_aux = self._get_fwd(is_train, False)(args, aux, rng)
+        if is_train:
+            for k, v in new_aux.items():
+                self.aux_dict[k]._set(v)
+        self._output_arrays = [nd.NDArray(o, self._ctx) for o in outs]
+        if self._naive:
+            for o in self._output_arrays:
+                o.wait_to_read()
+        return self._output_arrays
+
+    def backward(self, out_grads=None, is_train: bool = True):
+        self._forward_backward(out_grads, is_train=is_train, update_aux=False)
+
+    def forward_backward(self, out_grads=None, is_train: bool = True, **kwargs):
+        """Fused train step (one XLA program): forward + grads + aux update.
+        The hot path used by Module.fit."""
+        from . import ndarray as nd
+
+        for k, v in kwargs.items():
+            self.arg_dict[k][:] = v if not isinstance(v, np.ndarray) else \
+                nd.array(v, self._ctx)
+        self._last_rng = _random.next_key() if self._plan.stochastic_nodes else None
+        self._forward_backward(out_grads, is_train=is_train, update_aux=True,
+                               set_outputs=True)
+        return self._output_arrays
+
+    def _forward_backward(self, out_grads, is_train: bool, update_aux: bool,
+                          set_outputs: bool = False):
+        from . import ndarray as nd
+
+        plan = self._plan
+        diff_names = tuple(sorted(
+            n for n in plan.arg_names if self._grad_req.get(n, "null") != "null"))
+        if not diff_names:
+            if set_outputs:
+                self.forward(is_train=is_train)
+            return
+        add_names = tuple(sorted(
+            n for n in diff_names if self._grad_req[n] == "add"))
+        args = {k: v._data for k, v in self.arg_dict.items()}
+        aux = {k: v._data for k, v in self.aux_dict.items()}
+        diff_args = {k: args[k] for k in diff_names}
+        other_args = {k: v for k, v in args.items() if k not in diff_names}
+        rng = getattr(self, "_last_rng", None)
+        if rng is None and plan.stochastic_nodes:
+            rng = _random.next_key()
+        if out_grads is None:
+            ogs = [None] * len(plan.output_entries)
+        elif isinstance(out_grads, (list, tuple)):
+            ogs = [g._data if isinstance(g, nd.NDArray) else g for g in out_grads]
+        else:
+            ogs = [out_grads._data if isinstance(out_grads, nd.NDArray) else out_grads]
+        old_grads = {k: self.grad_dict[k]._data for k in add_names
+                     if k in self.grad_dict}
+        fn = self._get_fwd_bwd(is_train, diff_names, add_names)
+        outs, new_aux, grads = fn(diff_args, other_args, aux, rng, ogs, old_grads)
+        for name in diff_names:
+            if name in self.grad_dict:
+                self.grad_dict[name]._set(grads[name])
+            else:
+                self.grad_dict[name] = nd.NDArray(grads[name], self._ctx)
+        self.grad_arrays = [self.grad_dict.get(n) for n in plan.arg_names]
+        if update_aux:
+            for k, v in new_aux.items():
+                self.aux_dict[k]._set(v)
+        if set_outputs:
+            self._output_arrays = [nd.NDArray(o, self._ctx) for o in outs]
+        if self._naive:
+            for g in self.grad_dict.values():
+                g.wait_to_read()
+
+    # ------------------------------------------------------------------
+    # parameter management
+    # ------------------------------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name][:] = arr
+            elif not allow_extra_params:
+                raise MXNetError("Found name \"%s\" not in arguments" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name][:] = arr
+                elif not allow_extra_params:
+                    raise MXNetError("Found name \"%s\" not in aux states" % name)
+
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor bound to new input shapes (sharing the plan;
+        XLA compile cache keyed by shapes plays the role of the reference's
+        shared memory pool, graph_executor.cc:483-529)."""
+        from . import ndarray as nd
+
+        new_shapes = dict(kwargs)
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**new_shapes)
+        if arg_shapes is None:
+            raise MXNetError("cannot infer shapes for reshape")
+        args = {}
+        for name, shape in zip(self._plan.arg_names, arg_shapes):
+            cur = self.arg_dict[name]
+            if tuple(cur.shape) == tuple(shape):
+                args[name] = cur
+            else:
+                args[name] = nd.zeros(shape, self._ctx, dtype=cur.dtype)
+        aux = {}
+        for name, shape in zip(self._plan.aux_names, aux_shapes):
+            cur = self.aux_dict[name]
+            aux[name] = cur if tuple(cur.shape) == tuple(shape) else \
+                nd.zeros(shape, self._ctx, dtype=cur.dtype)
+        grads = {n: nd.zeros(args[n].shape, self._ctx, dtype=args[n].dtype)
+                 for n in self.grad_dict}
+        return Executor(self._symbol, self._ctx, args, grads or None,
+                        self._grad_req, aux, group2ctx=self._group2ctx,
+                        shared_exec=self)
+
+    def debug_str(self) -> str:
+        lines = ["Symbol outputs: %s" % ", ".join(self._plan.output_names)]
+        for n in self._plan.nodes:
+            if n.is_variable:
+                lines.append("Variable:%s" % n.name)
+            else:
+                lines.append("Op:%s, Name=%s" % (n.op.name, n.name))
+        total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                    for a in self.arg_dict.values())
+        lines.append("Total %d MB allocated for args" % (total >> 20))
+        return "\n".join(lines)

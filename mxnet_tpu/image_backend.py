@@ -1,0 +1,51 @@
+"""Host-side image decode backend.
+
+The reference uses OpenCV (src/io/image_io.cc, iter_image_recordio.cc).
+Here decoding happens on host CPU via PIL (fallback: raw numpy for uncompressed
+payloads); decoded uint8 HWC arrays are then fed to the device pipeline.
+"""
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+__all__ = ["decode_image", "resize_image", "HAVE_PIL"]
+
+try:
+    from PIL import Image
+
+    HAVE_PIL = True
+except ImportError:  # pragma: no cover
+    HAVE_PIL = False
+
+
+def decode_image(buf, channels: int = 3) -> np.ndarray:
+    """Decode an encoded image buffer to HWC uint8 (RGB order, matching the
+    reference's to_rgb=True default in imdecode)."""
+    if isinstance(buf, np.ndarray):
+        buf = buf.tobytes()
+    if not HAVE_PIL:
+        raise RuntimeError("No image decode backend available (PIL missing)")
+    img = Image.open(io.BytesIO(buf))
+    if channels == 3:
+        img = img.convert("RGB")
+    elif channels == 1:
+        img = img.convert("L")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def resize_image(arr: np.ndarray, w: int, h: int, interp: int = 1) -> np.ndarray:
+    if not HAVE_PIL:
+        raise RuntimeError("No image resize backend available (PIL missing)")
+    interp_map = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+                  3: Image.NEAREST, 4: Image.LANCZOS}
+    img = Image.fromarray(arr.squeeze() if arr.shape[-1] == 1 else arr)
+    img = img.resize((w, h), interp_map.get(interp, Image.BILINEAR))
+    out = np.asarray(img)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return out

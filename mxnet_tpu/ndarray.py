@@ -1,0 +1,620 @@
+"""NDArray — the imperative n-dim array over ``jax.Array``.
+
+TPU-native redesign of /root/reference/include/mxnet/ndarray.h:33-374 +
+src/ndarray/ndarray.cc.  The reference NDArray is a ref-counted chunk whose
+every mutation is pushed to the dependency engine; here the "engine" is JAX's
+async dispatch — every op returns immediately with a future-backed
+``jax.Array``; ``wait_to_read`` ≈ ``block_until_ready`` (ndarray.h:153-168).
+Mutation keeps MXNet surface semantics (``a[:] = x``, ``a += b``, ``out=``)
+by rebinding the underlying immutable buffer on the same Python object, so
+holders of the NDArray (executors, optimizers) observe updates.
+
+The whole ``mx.nd.<op>`` function surface is generated from the op registry
+at import, mirroring the reference's import-time codegen from the C op
+registry (python/mxnet/_ctypes/ndarray.py:165-200).
+
+Save/load keeps the reference's binary ``.params`` format bit-for-bit
+(src/ndarray/ndarray.cc:633-714: magic 0x112, TShape uint32s, Context two
+int32s, mshadow type flag, raw buffer; dmlc vector<string> keys).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from .base import MXNetError, mx_real_t
+from .context import Context, current_context
+from .ops import OpContext, registered_ops
+from .ops.param import _np_dtype
+from . import random as _random
+
+_pyslice = slice  # op autogen shadows builtins (slice/sum/max/...) at module level
+_pyabs = abs
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concatenate", "load", "save", "imdecode", "onehot_encode",
+           "waitall", "moveaxis"]
+
+
+def _default_ctx(ctx) -> Context:
+    return ctx if ctx is not None else current_context()
+
+
+def _as_jax(x, ctx=None, dtype=None):
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(x, NDArray):
+        data = x._data
+    elif isinstance(x, np.ndarray):
+        data = jnp.asarray(x)
+    elif isinstance(x, (int, float, np.generic)):
+        data = jnp.asarray(x, dtype or mx_real_t)
+    else:
+        data = jnp.asarray(x)
+    if dtype is not None:
+        dt = _np_dtype(dtype) if isinstance(dtype, str) else dtype
+        if data.dtype != dt:
+            data = data.astype(dt)
+    return data
+
+
+class NDArray:
+    """n-dim array on a device context (reference: include/mxnet/ndarray.h)."""
+
+    __slots__ = ("_data", "_ctx", "writable")
+
+    def __init__(self, data, ctx: Optional[Context] = None, writable: bool = True):
+        import jax.numpy as jnp
+
+        if isinstance(data, NDArray):
+            data = data._data
+        elif isinstance(data, np.ndarray) or np.isscalar(data):
+            data = jnp.asarray(data)
+        self._data = data
+        self._ctx = _default_ctx(ctx)
+        self.writable = writable
+
+    # -- properties --------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    @property
+    def ctx(self) -> Context:
+        return self._ctx
+
+    @property
+    def handle(self):
+        return self  # parity shim: C-handle == the object itself
+
+    # -- sync / host transfer ---------------------------------------------
+    def wait_to_read(self):
+        """Block until the async value is materialised (ndarray.h:153-160)."""
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(-1)[0]
+
+    def astype(self, dtype) -> "NDArray":
+        if isinstance(dtype, str):
+            dtype = _np_dtype(dtype)
+        return NDArray(self._data.astype(dtype), self._ctx)
+
+    # -- copies / context moves -------------------------------------------
+    def copy(self) -> "NDArray":
+        return NDArray(self._data, self._ctx)
+
+    def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
+        """Copy into a destination array or context (reference CopyFromTo,
+        ndarray.cc:250-328 — device-pair dispatch is jax.device_put here)."""
+        import jax
+
+        if isinstance(other, NDArray):
+            if other.shape != self.shape:
+                raise ValueError("shape mismatch in copyto")
+            other._set(jax.device_put(self._data, other._ctx.jax_device())
+                       .astype(other.dtype))
+            return other
+        ctx = Context(other)
+        return NDArray(jax.device_put(self._data, ctx.jax_device()), ctx)
+
+    def as_in_context(self, context: Context) -> "NDArray":
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    def _set(self, data):
+        if not self.writable:
+            raise MXNetError("trying to write to a readonly NDArray")
+        self._data = data
+
+    # -- shape ops (zero-copy in XLA; reference ndarray.h:286-352) ---------
+    def reshape(self, shape) -> "NDArray":
+        if isinstance(shape, int):
+            shape = (shape,)
+        from .ops.matrix import _reshape_target
+
+        return NDArray(self._data.reshape(_reshape_target(self.shape, shape)), self._ctx)
+
+    @property
+    def T(self) -> "NDArray":
+        return NDArray(self._data.T, self._ctx)
+
+    def slice(self, start, stop) -> "NDArray":
+        return NDArray(self._data[start:stop], self._ctx)
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    # -- indexing ----------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key._data
+        return NDArray(self._data[key], self._ctx)
+
+    def __setitem__(self, key, value):
+        import jax.numpy as jnp
+
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, np.ndarray):
+            value = jnp.asarray(value, self.dtype)
+        if isinstance(key, NDArray):
+            key = key._data
+        if isinstance(key, _pyslice) and key == _pyslice(None):
+            if np.isscalar(value):
+                self._set(jnp.full(self.shape, value, self.dtype))
+            else:
+                value = jnp.asarray(value, self.dtype)
+                self._set(jnp.broadcast_to(value, self.shape))
+        else:
+            self._set(self._data.at[key].set(value))
+
+    # -- arithmetic --------------------------------------------------------
+    def _binary(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return _invoke(op, (a, b), {})
+        if np.isscalar(other):
+            return _invoke(scalar_op, (self,), {"scalar": float(other)})
+        raise TypeError("unsupported operand type %s" % type(other))
+
+    def __add__(self, o):
+        return self._binary(o, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, o):
+        return self._binary(o, "broadcast_sub", "_rminus_scalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "broadcast_div", "_rdiv_scalar", reverse=True)
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, o):
+        return self._binary(o, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, o):
+        return self._binary(o, "broadcast_mod", "_rmod_scalar", reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, o):
+        return self._binary(o, "broadcast_power", "_rpower_scalar", reverse=True)
+
+    def __neg__(self):
+        return _invoke("negative", (self,), {})
+
+    def __abs__(self):
+        return _invoke("abs", (self,), {})
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return self._binary(o, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return self._binary(o, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, o):
+        return self._binary(o, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __iadd__(self, o):
+        out = self.__add__(o)
+        self._set(out._data)
+        return self
+
+    def __isub__(self, o):
+        out = self.__sub__(o)
+        self._set(out._data)
+        return self
+
+    def __imul__(self, o):
+        out = self.__mul__(o)
+        self._set(out._data)
+        return self
+
+    def __itruediv__(self, o):
+        out = self.__truediv__(o)
+        self._set(out._data)
+        return self
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("The truth value of an NDArray with multiple elements "
+                         "is ambiguous")
+
+    def __repr__(self):
+        return "<NDArray %s @%s>\n%s" % (
+            "x".join(str(s) for s in self.shape), self._ctx, self.asnumpy())
+
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "ctx_type": self._ctx.device_type,
+                "ctx_id": self._ctx.device_id, "writable": self.writable}
+
+    def __setstate__(self, state):
+        import jax.numpy as jnp
+
+        self._data = jnp.asarray(state["data"])
+        self._ctx = Context(state["ctx_type"], state["ctx_id"])
+        self.writable = state["writable"]
+
+
+# ---------------------------------------------------------------------------
+# Imperative invoke — the analogue of MXImperativeInvoke
+# (/root/reference/src/c_api/c_api_ndarray.cc:323)
+# ---------------------------------------------------------------------------
+
+
+def _invoke(op_name: str, args, kwargs):
+    op = registered_ops()[op_name]
+    out = kwargs.pop("out", None)
+    kwargs.pop("name", None)
+    nd_kwargs = {}
+    attrs = {}
+    for k, v in kwargs.items():
+        if isinstance(v, (NDArray, np.ndarray)) or hasattr(v, "dtype") and hasattr(v, "shape") and not np.isscalar(v):
+            nd_kwargs[k] = v
+        else:
+            attrs[k] = v
+    pos_inputs = [a for a in args if a is not None]
+    if op.key_var_num_args and op.key_var_num_args not in attrs:
+        attrs[op.key_var_num_args] = len(pos_inputs)
+    parsed = op.parse_attrs(attrs)
+    names = op.input_names(parsed) + list(op.aux)
+    inputs = list(pos_inputs)
+    if nd_kwargs:
+        slot = {n: a for n, a in zip(names, inputs)}
+        slot.update(nd_kwargs)
+        inputs = [slot[n] for n in names if n in slot]
+    ctx = None
+    for a in inputs:
+        if isinstance(a, NDArray):
+            ctx = a.context
+            break
+    if ctx is None:
+        ctx_attr = parsed.get("ctx")
+        if ctx_attr:
+            dt, _, di = str(ctx_attr).partition("(")
+            ctx = Context(dt, int(di.rstrip(")")) if di else 0)
+        else:
+            ctx = current_context()
+    jarrs = [a._data if isinstance(a, NDArray) else _as_jax(a) for a in inputs]
+    n_aux = len(op.aux)
+    aux_in = tuple(jarrs[len(jarrs) - n_aux:]) if n_aux else ()
+    main_in = jarrs[: len(jarrs) - n_aux] if n_aux else jarrs
+    opctx = OpContext(is_train=False,
+                      rng=_random.next_key() if op.stochastic else None)
+    outs, aux_updates = op.apply(opctx, parsed, main_in, aux_in)
+    # write aux updates back (engine-mutation parity for aux states)
+    if n_aux:
+        for holder, new in zip(inputs[len(inputs) - n_aux:], aux_updates):
+            if isinstance(holder, NDArray):
+                holder._set(new)
+    results = [NDArray(o, ctx) for o in outs]
+    if out is not None:
+        outs_t = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(outs_t, results):
+            dst._set(src._data.astype(dst.dtype) if dst.dtype != src.dtype else src._data)
+        return out
+    if len(results) == 1:
+        return results[0]
+    return results
+
+
+def _make_imperative(op_name: str, op):
+    def fn(*args, **kwargs):
+        return _invoke(op_name, args, kwargs)
+
+    fn.__name__ = op_name
+    fn.__doc__ = op.doc or "Auto-generated imperative wrapper for op %s" % op_name
+    return fn
+
+
+def _init_ops():
+    g = globals()
+    for name, op in registered_ops().items():
+        fn = _make_imperative(name, op)
+        g[name] = fn
+        if name.startswith("_"):
+            continue
+        __all__.append(name)
+
+
+# ---------------------------------------------------------------------------
+# Creation functions
+# ---------------------------------------------------------------------------
+
+
+def array(source_array, ctx=None, dtype=None) -> NDArray:
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(source_array, NDArray):
+        arr = source_array._data
+    else:
+        arr = np.asarray(source_array)
+    if dtype is None:
+        if arr.dtype == np.float64:
+            dtype = mx_real_t  # reference defaults to float32
+        elif arr.dtype == np.int64:
+            dtype = np.int32
+        else:
+            dtype = arr.dtype
+    if isinstance(dtype, str):
+        dtype = _np_dtype(dtype)
+    ctx = _default_ctx(ctx)
+    data = jax.device_put(jnp.asarray(arr, dtype), ctx.jax_device())
+    return NDArray(data, ctx)
+
+
+def empty(shape, ctx=None, dtype=mx_real_t) -> NDArray:
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype=mx_real_t) -> NDArray:
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    if isinstance(dtype, str):
+        dtype = _np_dtype(dtype)
+    ctx = _default_ctx(ctx)
+    return NDArray(jax.device_put(jnp.zeros(shape, dtype), ctx.jax_device()), ctx)
+
+
+def ones(shape, ctx=None, dtype=mx_real_t) -> NDArray:
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    if isinstance(dtype, str):
+        dtype = _np_dtype(dtype)
+    ctx = _default_ctx(ctx)
+    return NDArray(jax.device_put(jnp.ones(shape, dtype), ctx.jax_device()), ctx)
+
+
+def full(shape, val, ctx=None, dtype=mx_real_t) -> NDArray:
+    import jax.numpy as jnp
+
+    if isinstance(shape, int):
+        shape = (shape,)
+    if isinstance(dtype, str):
+        dtype = _np_dtype(dtype)
+    return NDArray(jnp.full(shape, val, dtype), _default_ctx(ctx))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=mx_real_t) -> NDArray:
+    import jax.numpy as jnp
+
+    if isinstance(dtype, str):
+        dtype = _np_dtype(dtype)
+    vals = np.arange(start, stop, step) if stop is not None else np.arange(start)
+    if repeat > 1:
+        vals = np.repeat(vals, repeat)
+    return NDArray(jnp.asarray(vals, dtype), _default_ctx(ctx))
+
+
+def moveaxis(tensor, source, destination) -> NDArray:
+    import jax.numpy as jnp
+
+    return NDArray(jnp.moveaxis(tensor._data, source, destination), tensor.context)
+
+
+def concatenate(arrays, axis=0, always_copy=True) -> NDArray:
+    import jax.numpy as jnp
+
+    assert arrays, "arrays must not be empty"
+    return NDArray(jnp.concatenate([a._data for a in arrays], axis=axis),
+                   arrays[0].context)
+
+
+def onehot_encode(indices, out) -> NDArray:
+    return _invoke("_onehot_encode", (indices, out), {"out": out})
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3, mean=None):
+    """Decode a JPEG/PNG buffer (reference: _imdecode NDArray function,
+    ndarray.cc:796+; OpenCV there, PIL here)."""
+    from .image_backend import decode_image
+
+    img = decode_image(str_img, channels)
+    if clip_rect and any(clip_rect):
+        x0, y0, x1, y1 = clip_rect
+        img = img[y0:y1, x0:x1]
+    arr = array(img)
+    if mean is not None:
+        arr = arr - mean
+    if out is not None:
+        out[:] = arr
+        return out
+    return arr
+
+
+def waitall():
+    """Block until all async work completes (reference: Engine WaitForAll via
+    MXNDArrayWaitAll)."""
+    import jax
+
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# Save / load — reference .params binary format, bit-for-bit
+# (src/ndarray/ndarray.cc:633-714)
+# ---------------------------------------------------------------------------
+
+_MAGIC = 0x112
+# mshadow type flags (mshadow/base.h enum order)
+_TYPE_FLAG = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3, "int32": 4,
+              "int8": 5, "int64": 6, "bfloat16": 7}
+_FLAG_TYPE = {v: k for k, v in _TYPE_FLAG.items()}
+
+
+def _save_one(f, arr: NDArray):
+    shape = arr.shape
+    f.write(struct.pack("<I", len(shape)))
+    if shape:
+        f.write(struct.pack("<%dI" % len(shape), *shape))
+    if len(shape) == 0:
+        return
+    dev_type = arr.context.device_typeid
+    f.write(struct.pack("<ii", dev_type, arr.context.device_id))
+    dtype_name = str(np.dtype(arr.dtype)) if arr.dtype != np.dtype("V2") else "bfloat16"
+    dtype_name = {"bfloat16": "bfloat16"}.get(dtype_name, dtype_name)
+    if dtype_name not in _TYPE_FLAG:
+        dtype_name = "float32"
+    f.write(struct.pack("<i", _TYPE_FLAG[dtype_name]))
+    host = arr.asnumpy()
+    if dtype_name == "bfloat16":
+        host = host.astype(np.float32)  # bf16 stored widened for portability
+    f.write(host.tobytes())
+
+
+def _load_one(f) -> NDArray:
+    (ndim,) = struct.unpack("<I", f.read(4))
+    shape = struct.unpack("<%dI" % ndim, f.read(4 * ndim)) if ndim else ()
+    if ndim == 0:
+        return NDArray(np.zeros(()), cpu_ctx())
+    dev_type, dev_id = struct.unpack("<ii", f.read(8))
+    (type_flag,) = struct.unpack("<i", f.read(4))
+    dtype_name = _FLAG_TYPE.get(type_flag, "float32")
+    np_dtype = np.float32 if dtype_name == "bfloat16" else np.dtype(dtype_name)
+    count = int(np.prod(shape))
+    buf = f.read(count * np_dtype.itemsize)
+    host = np.frombuffer(buf, dtype=np_dtype).reshape(shape)
+    arr = array(host, dtype="bfloat16" if dtype_name == "bfloat16" else None)
+    return arr
+
+
+def cpu_ctx():
+    from .context import cpu
+
+    return cpu()
+
+
+def save(fname: str, data) -> None:
+    """Save NDArrays in the reference's .params container format."""
+    if isinstance(data, NDArray):
+        data = [data]
+    names: List[str] = []
+    arrays: List[NDArray] = []
+    if isinstance(data, dict):
+        for k, v in data.items():
+            names.append(k)
+            arrays.append(v)
+    else:
+        arrays = list(data)
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", _MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for arr in arrays:
+            _save_one(f, arr)
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            nb = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(nb)))
+            f.write(nb)
+
+
+def load(fname: str):
+    """Load a .params container; returns dict if names present, else list."""
+    with open(fname, "rb") as f:
+        magic, _res = struct.unpack("<QQ", f.read(16))
+        if magic != _MAGIC:
+            raise MXNetError("Invalid NDArray file format (magic %#x)" % magic)
+        (n,) = struct.unpack("<Q", f.read(8))
+        arrays = [_load_one(f) for _ in range(n)]
+        (nk,) = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(nk):
+            (ln,) = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+_init_ops()

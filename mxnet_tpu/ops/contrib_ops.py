@@ -1,0 +1,387 @@
+"""Contrib ops: FFT/IFFT, CountSketch, and the SSD / Faster-RCNN detection
+ops (MultiBoxPrior/Target/Detection, Proposal).
+
+Parity surface: /root/reference/src/operator/contrib/ (fft-inl.h uses cuFFT —
+here jnp.fft lowered by XLA; count_sketch-inl.h; multibox_*-inl.h;
+proposal-inl.h).  Detection post-processing (matching, NMS) is written with
+static shapes + lax.fori_loop so it stays jittable on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .param import Param
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# FFT / IFFT — reference pads the last dim to the compute size; output packs
+# complex as interleaved (real, imag) pairs doubling the last dim.
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_fft", params={"compute_size": Param(int, 128)},
+          infer_shape=lambda attrs, s: (
+              s, [tuple(s[0][:-1]) + (s[0][-1] * 2,)] if s[0] else [None], []),
+          hint="fft")
+def _fft(opctx, attrs, x):
+    out = jnp.fft.fft(x.astype(jnp.float32), axis=-1)
+    packed = jnp.stack([out.real, out.imag], axis=-1)
+    return packed.reshape(x.shape[:-1] + (x.shape[-1] * 2,)).astype(x.dtype)
+
+
+@register("_contrib_ifft", params={"compute_size": Param(int, 128)},
+          infer_shape=lambda attrs, s: (
+              s, [tuple(s[0][:-1]) + (s[0][-1] // 2,)] if s[0] else [None], []),
+          hint="ifft")
+def _ifft(opctx, attrs, x):
+    n = x.shape[-1] // 2
+    pairs = x.reshape(x.shape[:-1] + (n, 2)).astype(jnp.float32)
+    cplx = pairs[..., 0] + 1j * pairs[..., 1]
+    out = jnp.fft.ifft(cplx, axis=-1)
+    # reference ifft returns unnormalized result * n? cuFFT inverse is
+    # unnormalized; keep cuFFT semantics (scale by n).
+    return (out.real * n).astype(x.dtype)
+
+
+@register("_contrib_count_sketch", inputs=("data", "h", "s"),
+          params={"out_dim": Param(int, required=True),
+                  "processing_batch_size": Param(int, 32)},
+          no_grad_inputs=("h", "s"),
+          infer_shape=lambda attrs, shapes: (
+              shapes, [(shapes[0][0], attrs["out_dim"]) if shapes[0] else None], []),
+          hint="count_sketch")
+def _count_sketch(opctx, attrs, data, h, s):
+    """out[n, h[i]] += s[i] * data[n, i] (count_sketch-inl.h)."""
+    out_dim = attrs["out_dim"]
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(data.dtype)
+    vals = data * sign[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return out.at[:, idx].add(vals)
+
+
+# ---------------------------------------------------------------------------
+# Box utilities shared by the detection ops
+# ---------------------------------------------------------------------------
+
+
+def _iou(a, b):
+    """IoU between corner boxes a (..., 4) and b (..., 4), broadcasting."""
+    ix0 = jnp.maximum(a[..., 0], b[..., 0])
+    iy0 = jnp.maximum(a[..., 1], b[..., 1])
+    ix1 = jnp.minimum(a[..., 2], b[..., 2])
+    iy1 = jnp.minimum(a[..., 3], b[..., 3])
+    iw = jnp.maximum(ix1 - ix0, 0.0)
+    ih = jnp.maximum(iy1 - iy0, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior — anchor generation (multibox_prior-inl.h)
+# ---------------------------------------------------------------------------
+
+
+def _mbp_num_anchors(attrs):
+    return len(attrs.get("sizes", (1.0,))) + len(attrs.get("ratios", (1.0,))) - 1
+
+
+def _mbp_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    na = _mbp_num_anchors(attrs)
+    return in_shapes, [(1, d[2] * d[3] * na, 4)], []
+
+
+@register("_contrib_MultiBoxPrior",
+          params={"sizes": Param("shape", (1.0,)), "ratios": Param("shape", (1.0,)),
+                  "clip": Param(bool, False), "steps": Param("shape", (-1.0, -1.0)),
+                  "offsets": Param("shape", (0.5, 0.5))},
+          infer_shape=_mbp_infer, no_grad_inputs=("data",), hint="multibox_prior")
+def _multibox_prior(opctx, attrs, data):
+    # note: sizes/ratios parse through the shape parser; floats survive via
+    # ast.literal_eval in param._parse_shape when written as python tuples —
+    # re-read raw attrs to keep fractional values.
+    sizes = tuple(float(v) for v in _raw_tuple(attrs, "sizes", (1.0,)))
+    ratios = tuple(float(v) for v in _raw_tuple(attrs, "ratios", (1.0,)))
+    offy, offx = tuple(float(v) for v in _raw_tuple(attrs, "offsets", (0.5, 0.5)))
+    h, w = data.shape[2], data.shape[3]
+    cy = (jnp.arange(h) + offy) / h
+    cx = (jnp.arange(w) + offx) / w
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+    whs = []
+    for r in ratios:
+        whs.append((sizes[0] * np.sqrt(r) / 2.0, sizes[0] / np.sqrt(r) / 2.0))
+    for s in sizes[1:]:
+        whs.append((s * np.sqrt(ratios[0]) / 2.0, s / np.sqrt(ratios[0]) / 2.0))
+    boxes = []
+    for hw, hh in whs:
+        boxes.append(jnp.stack([gx - hw, gy - hh, gx + hw, gy + hh], axis=-1))
+    out = jnp.stack(boxes, axis=2).reshape(1, -1, 4)  # (1, H*W*A, 4)
+    if attrs.get("clip"):
+        out = jnp.clip(out, 0.0, 1.0)
+    return out.astype(data.dtype)
+
+
+def _raw_tuple(attrs, key, default):
+    v = attrs.get(key, default)
+    if v is None:
+        return default
+    if isinstance(v, str):
+        import ast
+
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, float)):
+        return (v,)
+    return tuple(v)
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget — anchor/GT matching + target encoding (multibox_target-inl.h)
+# ---------------------------------------------------------------------------
+
+
+def _mbt_infer(attrs, in_shapes):
+    anchor, label, cls = in_shapes
+    if anchor is None or label is None:
+        return in_shapes, [None, None, None], []
+    a = anchor[1]
+    n = label[0]
+    return in_shapes, [(n, a * 4), (n, a * 4), (n, a)], []
+
+
+@register("_contrib_MultiBoxTarget", inputs=("anchor", "label", "cls_pred"),
+          params={"overlap_threshold": Param(float, 0.5),
+                  "ignore_label": Param(float, -1.0),
+                  "negative_mining_ratio": Param(float, -1.0),
+                  "negative_mining_thresh": Param(float, 0.5),
+                  "minimum_negative_samples": Param(int, 0),
+                  "variances": Param("shape", (0.1, 0.1, 0.2, 0.2))},
+          num_outputs=3, infer_shape=_mbt_infer,
+          no_grad_inputs=("anchor", "label", "cls_pred"),
+          output_names=lambda attrs: ["loc_target", "loc_mask", "cls_target"],
+          hint="multibox_target")
+def _multibox_target(opctx, attrs, anchor, label, cls_pred):
+    v0, v1, v2, v3 = tuple(float(v) for v in _raw_tuple(attrs, "variances",
+                                                        (0.1, 0.1, 0.2, 0.2)))
+    thresh = attrs.get("overlap_threshold", 0.5)
+    anchors = anchor.reshape(-1, 4)  # (A, 4)
+    A = anchors.shape[0]
+
+    def per_sample(lbl, pred):
+        valid = lbl[:, 0] >= 0  # (O,)
+        ious = _iou(anchors[:, None, :], lbl[None, :, 1:5])  # (A, O)
+        ious = jnp.where(valid[None, :], ious, -1.0)
+        best_gt = jnp.argmax(ious, axis=1)  # (A,)
+        best_iou = jnp.max(ious, axis=1)
+        # force-match: the best anchor of each valid gt
+        best_anchor = jnp.argmax(ious, axis=0)  # (O,)
+        forced = jnp.zeros((A,), bool).at[best_anchor].set(valid)
+        forced_gt = jnp.zeros((A,), jnp.int32).at[best_anchor].set(
+            jnp.arange(lbl.shape[0], dtype=jnp.int32))
+        pos = forced | (best_iou >= thresh)
+        gt_idx = jnp.where(forced, forced_gt, best_gt)
+        gt = lbl[gt_idx]  # (A, 5)
+        # encode loc targets with variances
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = gt[:, 3] - gt[:, 1]
+        gh = gt[:, 4] - gt[:, 2]
+        gcx = (gt[:, 1] + gt[:, 3]) / 2
+        gcy = (gt[:, 2] + gt[:, 4]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-12) / v0
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-12) / v1
+        tw = jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-12), 1e-12)) / v2
+        th = jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-12), 1e-12)) / v3
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1) * pos[:, None]
+        loc_m = jnp.tile(pos[:, None].astype(anchors.dtype), (1, 4))
+        cls_t = jnp.where(pos, gt[:, 0] + 1.0, 0.0)
+        mining = attrs.get("negative_mining_ratio", -1.0)
+        if mining is not None and mining > 0:
+            # hard negative mining: rank negatives by max non-background prob
+            neg_score = jnp.max(pred[1:, :], axis=0)  # (A,)
+            neg_score = jnp.where(pos, -jnp.inf, neg_score)
+            num_pos = jnp.sum(pos)
+            k = jnp.minimum(
+                jnp.maximum((num_pos * mining).astype(jnp.int32),
+                            attrs.get("minimum_negative_samples", 0)), A)
+            order = jnp.argsort(-neg_score)
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A, dtype=jnp.int32))
+            keep_neg = rank < k
+            cls_t = jnp.where(pos, cls_t, jnp.where(keep_neg, 0.0, -1.0))
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(per_sample)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection — decode + per-class NMS (multibox_detection-inl.h)
+# ---------------------------------------------------------------------------
+
+
+def _nms_suppress(boxes, scores, ids, valid, nms_thresh, force_suppress, topk):
+    """Greedy NMS with static shapes: iterate the topk highest-score boxes."""
+    A = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    k = min(topk if topk > 0 else A, A)
+
+    def body(i, keep):
+        idx = order[i]
+        alive = keep[idx] & valid[idx]
+        ious = _iou(boxes[idx][None, :], boxes)  # (A,)
+        same_cls = (ids == ids[idx]) | force_suppress
+        later = jnp.zeros((A,), bool).at[order[i + 1:]].set(True) if False else None
+        del later
+        rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+        suppress = (ious > nms_thresh) & same_cls & (rank > i)
+        return jnp.where(alive & suppress, False, keep)
+
+    keep = jnp.ones((A,), bool)
+    keep = lax.fori_loop(0, k, body, keep)
+    return keep
+
+
+def _mbd_infer(attrs, in_shapes):
+    cls = in_shapes[0]
+    if cls is None:
+        return in_shapes, [None], []
+    return in_shapes, [(cls[0], cls[2], 6)], []
+
+
+@register("_contrib_MultiBoxDetection", inputs=("cls_prob", "loc_pred", "anchor"),
+          params={"clip": Param(bool, True), "threshold": Param(float, 0.01),
+                  "background_id": Param(int, 0), "nms_threshold": Param(float, 0.5),
+                  "force_suppress": Param(bool, False),
+                  "variances": Param("shape", (0.1, 0.1, 0.2, 0.2)),
+                  "nms_topk": Param(int, -1)},
+          infer_shape=_mbd_infer,
+          no_grad_inputs=("cls_prob", "loc_pred", "anchor"),
+          hint="multibox_detection")
+def _multibox_detection(opctx, attrs, cls_prob, loc_pred, anchor):
+    v0, v1, v2, v3 = tuple(float(v) for v in _raw_tuple(attrs, "variances",
+                                                        (0.1, 0.1, 0.2, 0.2)))
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def per_sample(probs, locs):
+        d = locs.reshape(-1, 4)
+        cx = d[:, 0] * v0 * aw + acx
+        cy = d[:, 1] * v1 * ah + acy
+        w_ = jnp.exp(d[:, 2] * v2) * aw / 2
+        h_ = jnp.exp(d[:, 3] * v3) * ah / 2
+        boxes = jnp.stack([cx - w_, cy - h_, cx + w_, cy + h_], axis=-1)
+        if attrs.get("clip", True):
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        scores = jnp.max(probs[1:, :], axis=0)  # best non-background
+        ids = jnp.argmax(probs[1:, :], axis=0).astype(jnp.float32)
+        valid = scores > attrs.get("threshold", 0.01)
+        keep = _nms_suppress(boxes, scores, ids, valid,
+                             attrs.get("nms_threshold", 0.5),
+                             bool(attrs.get("force_suppress", False)),
+                             int(attrs.get("nms_topk", -1)))
+        ok = valid & keep
+        out_ids = jnp.where(ok, ids, -1.0)
+        return jnp.concatenate([out_ids[:, None], scores[:, None], boxes], axis=-1)
+
+    return jax.vmap(per_sample)(cls_prob, loc_pred)
+
+
+# ---------------------------------------------------------------------------
+# Proposal — RPN proposal generation (proposal-inl.h)
+# ---------------------------------------------------------------------------
+
+
+def _proposal_infer(attrs, in_shapes):
+    cls = in_shapes[0]
+    if cls is None:
+        return in_shapes, [None], []
+    n = attrs.get("rpn_post_nms_top_n", 300)
+    return in_shapes, [(cls[0] * n, 5)], []
+
+
+@register("_contrib_Proposal", inputs=("cls_prob", "bbox_pred", "im_info"),
+          params={"rpn_pre_nms_top_n": Param(int, 6000),
+                  "rpn_post_nms_top_n": Param(int, 300),
+                  "threshold": Param(float, 0.7),
+                  "rpn_min_size": Param(int, 16),
+                  "scales": Param("shape", (4, 8, 16, 32)),
+                  "ratios": Param("shape", (0.5, 1, 2)),
+                  "feature_stride": Param(int, 16),
+                  "output_score": Param(bool, False),
+                  "iou_loss": Param(bool, False)},
+          infer_shape=_proposal_infer,
+          no_grad_inputs=("cls_prob", "bbox_pred", "im_info"), hint="proposal")
+def _proposal(opctx, attrs, cls_prob, bbox_pred, im_info):
+    scales = tuple(float(v) for v in _raw_tuple(attrs, "scales", (4, 8, 16, 32)))
+    ratios = tuple(float(v) for v in _raw_tuple(attrs, "ratios", (0.5, 1, 2)))
+    stride = attrs.get("feature_stride", 16)
+    n, _, fh, fw = cls_prob.shape
+    base = stride
+    anchors = []
+    for r in ratios:
+        for s in scales:
+            size = base * base
+            ws = np.sqrt(size / r)
+            hs = ws * r
+            w_, h_ = ws * s, hs * s
+            cx = (base - 1) / 2.0
+            cy = (base - 1) / 2.0
+            anchors.append([cx - (w_ - 1) / 2, cy - (h_ - 1) / 2,
+                            cx + (w_ - 1) / 2, cy + (h_ - 1) / 2])
+    base_anchors = jnp.asarray(np.array(anchors), cls_prob.dtype)  # (K, 4)
+    K = base_anchors.shape[0]
+    sy = jnp.arange(fh) * stride
+    sx = jnp.arange(fw) * stride
+    gy, gx = jnp.meshgrid(sy, sx, indexing="ij")
+    shifts = jnp.stack([gx, gy, gx, gy], axis=-1).reshape(-1, 1, 4)  # (HW,1,4)
+    all_anchors = (base_anchors[None, :, :] + shifts).reshape(-1, 4)  # (HW*K,4)
+    A = all_anchors.shape[0]
+    post_n = int(attrs.get("rpn_post_nms_top_n", 300))
+
+    def per_sample(probs, deltas, info):
+        # cls_prob layout (2K, H, W): first K background, last K foreground
+        scores = probs[K:, :, :].transpose(1, 2, 0).reshape(-1)
+        d = deltas.transpose(1, 2, 0).reshape(-1, 4)
+        aw = all_anchors[:, 2] - all_anchors[:, 0] + 1.0
+        ah = all_anchors[:, 3] - all_anchors[:, 1] + 1.0
+        acx = all_anchors[:, 0] + 0.5 * (aw - 1)
+        acy = all_anchors[:, 1] + 0.5 * (ah - 1)
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        w_ = jnp.exp(d[:, 2]) * aw
+        h_ = jnp.exp(d[:, 3]) * ah
+        boxes = jnp.stack([cx - 0.5 * (w_ - 1), cy - 0.5 * (h_ - 1),
+                           cx + 0.5 * (w_ - 1), cy + 0.5 * (h_ - 1)], axis=-1)
+        imh, imw = info[0], info[1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, imw - 1),
+                           jnp.clip(boxes[:, 1], 0, imh - 1),
+                           jnp.clip(boxes[:, 2], 0, imw - 1),
+                           jnp.clip(boxes[:, 3], 0, imh - 1)], axis=-1)
+        min_size = attrs.get("rpn_min_size", 16) * info[2]
+        keep_size = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_size) & \
+                    ((boxes[:, 3] - boxes[:, 1] + 1) >= min_size)
+        scores_f = jnp.where(keep_size, scores, -jnp.inf)
+        ids = jnp.zeros((A,), jnp.float32)
+        keep = _nms_suppress(boxes, scores_f, ids, keep_size,
+                             attrs.get("threshold", 0.7), True,
+                             int(attrs.get("rpn_pre_nms_top_n", 6000)))
+        final = jnp.where(keep, scores_f, -jnp.inf)
+        top = jnp.argsort(-final)[:post_n]
+        sel = boxes[top]
+        return jnp.concatenate([jnp.zeros((post_n, 1), sel.dtype), sel], axis=-1)
+
+    out = jax.vmap(per_sample)(cls_prob, bbox_pred, im_info)
+    return out.reshape(-1, 5)

@@ -1,0 +1,109 @@
+"""Indexing ops: Embedding / take / batch_take / one_hot / pick and the
+registered NDArray helpers (_onehot_encode, choose_element_0index,
+fill_element_0index).
+
+Parity surface: /root/reference/src/operator/tensor/indexing_op.{h,cc} and
+the MXNET_REGISTER_NDARRAY_FUN entries in src/ndarray/ndarray.cc:796+.
+Gathers lower to XLA gather/one-hot-matmul; Embedding's gradient is a
+scatter-add XLA handles natively (the reference needs AddTakeGrad kernels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .param import Param, _np_dtype
+from .registry import register
+
+
+def _embedding_infer(attrs, in_shapes):
+    data, weight = in_shapes
+    w = (attrs["input_dim"], attrs["output_dim"])
+    out = None if data is None else tuple(data) + (attrs["output_dim"],)
+    return [data, w], [out], []
+
+
+@register("Embedding", inputs=("data", "weight"),
+          params={"input_dim": Param(int, required=True),
+                  "output_dim": Param(int, required=True),
+                  "dtype": Param("dtype", "float32")},
+          infer_shape=_embedding_infer, no_grad_inputs=("data",), hint="embedding")
+def _embedding(opctx, attrs, data, weight):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("take", inputs=("a", "indices"),
+          params={"axis": Param(int, 0),
+                  "mode": Param(str, "clip", enum=("clip", "wrap", "raise"))},
+          no_grad_inputs=("indices",))
+def _take(opctx, attrs, a, indices):
+    mode = attrs.get("mode", "clip")
+    return jnp.take(a, indices.astype(jnp.int32), axis=attrs.get("axis", 0),
+                    mode="wrap" if mode == "wrap" else "clip")
+
+
+@register("batch_take", inputs=("a", "indices"), no_grad_inputs=("indices",))
+def _batch_take(opctx, attrs, a, indices):
+    idx = indices.astype(jnp.int32).reshape(-1)
+    return a[jnp.arange(a.shape[0]), idx]
+
+
+def _one_hot_infer(attrs, in_shapes):
+    (ishape,) = in_shapes
+    if ishape is None:
+        return in_shapes, [None], []
+    return in_shapes, [tuple(ishape) + (attrs["depth"],)], []
+
+
+@register("one_hot", inputs=("indices",),
+          params={"depth": Param(int, required=True), "on_value": Param(float, 1.0),
+                  "off_value": Param(float, 0.0), "dtype": Param("dtype", "float32")},
+          infer_shape=_one_hot_infer, no_grad_inputs=("indices",))
+def _one_hot(opctx, attrs, indices):
+    dtype = _np_dtype(attrs.get("dtype", "float32"))
+    depth = attrs["depth"]
+    on, off = attrs.get("on_value", 1.0), attrs.get("off_value", 0.0)
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype)
+    return oh * (on - off) + off
+
+
+def _pick_infer(attrs, in_shapes):
+    data, index = in_shapes
+    if data is None:
+        return in_shapes, [None], []
+    axis = attrs.get("axis", -1) % len(data)
+    out = list(data)
+    if attrs.get("keepdims", False):
+        out[axis] = 1
+    else:
+        del out[axis]
+    return in_shapes, [tuple(out)], []
+
+
+@register("pick", inputs=("data", "index"),
+          params={"axis": Param(int, -1), "keepdims": Param(bool, False)},
+          infer_shape=_pick_infer, no_grad_inputs=("index",),
+          aliases=("choose_element_0index",))
+def _pick(opctx, attrs, data, index):
+    axis = attrs.get("axis", -1) % data.ndim
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not attrs.get("keepdims", False):
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("fill_element_0index", inputs=("lhs", "mhs", "rhs"),
+          no_grad_inputs=("rhs",))
+def _fill_element_0index(opctx, attrs, lhs, mhs, rhs):
+    """lhs[i, rhs[i]] = mhs[i] (reference: ndarray.cc TernaryOp registration)."""
+    idx = rhs.astype(jnp.int32)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)
+
+
+@register("_onehot_encode", inputs=("lhs", "rhs"), no_grad_inputs=("lhs",))
+def _onehot_encode(opctx, attrs, lhs, rhs):
+    """Write one-hot rows of lhs's indices into rhs's shape (reference:
+    ndarray.cc:796+ _onehot_encode(index, out))."""
+    depth = rhs.shape[1]
+    return jax.nn.one_hot(lhs.astype(jnp.int32), depth, dtype=rhs.dtype)

@@ -1,0 +1,81 @@
+"""Initialization ops (_zeros/_ones/_full/_arange, *_like).
+
+Parity surface: /root/reference/src/operator/tensor/init_op.{h,cc}.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .param import Param, _np_dtype
+from .registry import register
+
+_INIT_SPEC = {
+    "shape": Param("shape", ()),
+    "dtype": Param("dtype", "float32"),
+    "ctx": Param(str, ""),
+}
+
+
+def _init_infer(attrs, in_shapes):
+    return in_shapes, [tuple(attrs.get("shape") or ())], []
+
+
+@register("_zeros", inputs=(), params=dict(_INIT_SPEC), infer_shape=_init_infer,
+          hint="zeros")
+def _zeros(opctx, attrs):
+    return jnp.zeros(attrs.get("shape") or (), _np_dtype(attrs.get("dtype", "float32")))
+
+
+@register("_ones", inputs=(), params=dict(_INIT_SPEC), infer_shape=_init_infer,
+          hint="ones")
+def _ones(opctx, attrs):
+    return jnp.ones(attrs.get("shape") or (), _np_dtype(attrs.get("dtype", "float32")))
+
+
+@register("_full", inputs=(), params={**_INIT_SPEC, "value": Param(float, 0.0)},
+          infer_shape=_init_infer, hint="full")
+def _full(opctx, attrs):
+    return jnp.full(attrs.get("shape") or (), attrs.get("value", 0.0),
+                    _np_dtype(attrs.get("dtype", "float32")))
+
+
+def _arange_vals(attrs):
+    import numpy as np
+
+    start = attrs.get("start", 0.0)
+    stop = attrs.get("stop")
+    step = attrs.get("step", 1.0)
+    rep = int(attrs.get("repeat", 1))
+    if stop is None:
+        start, stop = 0.0, start
+    vals = np.arange(start, stop, step)
+    if rep > 1:
+        vals = np.repeat(vals, rep)
+    return vals
+
+
+@register("_arange", inputs=(),
+          params={"start": Param(float, 0.0), "stop": Param("float-or-none", None),
+                  "step": Param(float, 1.0), "repeat": Param(int, 1),
+                  "dtype": Param("dtype", "float32"), "ctx": Param(str, "")},
+          infer_shape=lambda attrs, s: (s, [(len(_arange_vals(attrs)),)], []),
+          hint="arange")
+def _arange(opctx, attrs):
+    return jnp.asarray(_arange_vals(attrs), _np_dtype(attrs.get("dtype", "float32")))
+
+
+@register("zeros_like")
+def _zeros_like(opctx, attrs, x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def _ones_like(opctx, attrs, x):
+    return jnp.ones_like(x)
+
+
+@register("_set_value", inputs=(), params={"src": Param(float, 0.0)})
+def _set_value(opctx, attrs, *a):
+    """Imperative fill; the ndarray layer routes out= handling
+    (reference: ndarray.cc _set_value NDArray function)."""
+    return jnp.asarray(attrs.get("src", 0.0))

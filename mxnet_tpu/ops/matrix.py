@@ -1,0 +1,347 @@
+"""Shape-manipulation and linear-algebra ops.
+
+Parity surface: /root/reference/src/operator/tensor/matrix_op-inl.h
+(Reshape/Flatten/transpose/dot/batch_dot/slice/slice_axis/clip/repeat/tile/
+reverse/expand_dims/_slice_assign/_crop_assign_scalar), concat.cc,
+slice_channel.cc, pad.cc, swapaxis.cc, crop.cc.  Dots hit the MXU via XLA;
+everything else is layout work XLA folds into neighbours.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .param import Param
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# Reshape family
+# ---------------------------------------------------------------------------
+
+
+def _reshape_target(ishape, target):
+    """MXNet Reshape special codes (matrix_op-inl.h ReshapeParam): 0 copy dim,
+    -1 infer, -2 copy remaining, -3 merge next two, -4 split (use next two)."""
+    out = []
+    src = list(ishape)
+    i = 0
+    t = list(target)
+    k = 0
+    while k < len(t):
+        s = t[k]
+        if s == 0:
+            out.append(src[i])
+            i += 1
+        elif s == -1:
+            out.append(-1)
+            i += 1
+        elif s == -2:
+            out.extend(src[i:])
+            i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1])
+            i += 2
+        elif s == -4:
+            d1, d2 = t[k + 1], t[k + 2]
+            k += 2
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2])
+            i += 1
+        else:
+            out.append(s)
+            i += 1
+        k += 1
+    if -1 in out:
+        known = int(np.prod([d for d in out if d != -1])) or 1
+        total = int(np.prod(ishape)) if ishape else 1
+        out[out.index(-1)] = total // known
+    return tuple(int(d) for d in out)
+
+
+def _reshape_infer(attrs, in_shapes):
+    (ishape,) = in_shapes
+    if ishape is None:
+        return in_shapes, [None], []
+    target = attrs.get("shape") or attrs.get("target_shape")
+    return in_shapes, [_reshape_target(ishape, target)], []
+
+
+@register("Reshape", aliases=("reshape",),
+          params={"shape": Param("shape", ()), "target_shape": Param("shape-or-none", None),
+                  "keep_highest": Param(bool, False), "reverse": Param(bool, False)},
+          infer_shape=_reshape_infer, hint="reshape")
+def _reshape(opctx, attrs, x):
+    target = attrs.get("shape") or attrs.get("target_shape")
+    return jnp.reshape(x, _reshape_target(x.shape, target))
+
+
+def _flatten_infer(attrs, in_shapes):
+    (ishape,) = in_shapes
+    if ishape is None:
+        return in_shapes, [None], []
+    return in_shapes, [(ishape[0], int(np.prod(ishape[1:])) if len(ishape) > 1 else 1)], []
+
+
+@register("Flatten", aliases=("flatten",), infer_shape=_flatten_infer, hint="flatten")
+def _flatten(opctx, attrs, x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose", params={"axes": Param("shape", ())})
+def _transpose(opctx, attrs, x):
+    axes = attrs.get("axes") or None
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims", params={"axis": Param(int, required=True)})
+def _expand_dims(opctx, attrs, x):
+    return jnp.expand_dims(x, attrs["axis"])
+
+
+@register("SwapAxis", aliases=("swapaxes", "SwapAxes"),
+          params={"dim1": Param(int, 0), "dim2": Param(int, 0)}, hint="swapaxis")
+def _swapaxis(opctx, attrs, x):
+    return jnp.swapaxes(x, attrs.get("dim1", 0), attrs.get("dim2", 0))
+
+
+@register("Cast", aliases=("cast",), params={"dtype": Param("dtype", required=True)},
+          hint="cast")
+def _cast(opctx, attrs, x):
+    from .param import _np_dtype
+
+    return x.astype(_np_dtype(attrs["dtype"]))
+
+
+# ---------------------------------------------------------------------------
+# Slicing
+# ---------------------------------------------------------------------------
+
+
+@register("slice", aliases=("crop",),
+          params={"begin": Param("shape", required=True), "end": Param("shape", required=True)})
+def _slice(opctx, attrs, x):
+    begin, end = attrs["begin"], attrs["end"]
+    idx = tuple(slice(b, e if e != 0 else None) for b, e in zip(begin, end))
+    return x[idx]
+
+
+@register("slice_axis",
+          params={"axis": Param(int, required=True), "begin": Param(int, 0),
+                  "end": Param("int-or-none", None)})
+def _slice_axis(opctx, attrs, x):
+    axis = attrs["axis"] % x.ndim
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(attrs.get("begin", 0), attrs.get("end"))
+    return x[tuple(idx)]
+
+
+@register("_slice_assign", aliases=("_crop_assign",), inputs=("lhs", "rhs"),
+          params={"begin": Param("shape", required=True), "end": Param("shape", required=True)})
+def _slice_assign(opctx, attrs, lhs, rhs):
+    begin, end = attrs["begin"], attrs["end"]
+    idx = tuple(slice(b, e if e != 0 else None) for b, e in zip(begin, end))
+    return lhs.at[idx].set(rhs)
+
+
+@register("_crop_assign_scalar",
+          params={"begin": Param("shape", required=True), "end": Param("shape", required=True),
+                  "scalar": Param(float, 0.0)})
+def _crop_assign_scalar(opctx, attrs, x):
+    begin, end = attrs["begin"], attrs["end"]
+    idx = tuple(slice(b, e if e != 0 else None) for b, e in zip(begin, end))
+    return x.at[idx].set(attrs.get("scalar", 0.0))
+
+
+@register("clip", params={"a_min": Param(float, required=True),
+                          "a_max": Param(float, required=True)})
+def _clip(opctx, attrs, x):
+    return jnp.clip(x, attrs["a_min"], attrs["a_max"])
+
+
+@register("repeat", params={"repeats": Param(int, required=True),
+                            "axis": Param("int-or-none", None)})
+def _repeat(opctx, attrs, x):
+    return jnp.repeat(x, attrs["repeats"], axis=attrs.get("axis"))
+
+
+@register("tile", params={"reps": Param("shape", required=True)})
+def _tile(opctx, attrs, x):
+    return jnp.tile(x, attrs["reps"])
+
+
+@register("reverse", aliases=("flip",), params={"axis": Param("shape", required=True)})
+def _reverse(opctx, attrs, x):
+    axis = attrs["axis"]
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(x, axis=axis)
+
+
+@register("where", inputs=("condition", "x", "y"))
+def _where(opctx, attrs, cond, x, y):
+    return jnp.where(cond.astype(bool), x, y)
+
+
+@register("_identity_with_attr_like_rhs", inputs=("lhs", "rhs"),
+          no_grad_inputs=("rhs",))
+def _identity_like_rhs(opctx, attrs, lhs, rhs):
+    return lhs
+
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot — the MXU path (reference: mshadow dot → cuBLAS,
+# fully_connected-inl.h:58-59; here jnp.matmul → XLA DotGeneral)
+# ---------------------------------------------------------------------------
+
+_DOT_SPEC = {"transpose_a": Param(bool, False), "transpose_b": Param(bool, False)}
+
+
+def _dot_infer(attrs, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        return in_shapes, [None], []
+    ta, tb = attrs.get("transpose_a", False), attrs.get("transpose_b", False)
+    if len(a) == 1 and len(b) == 1:
+        return in_shapes, [(1,)], []
+    am = a[::-1] if ta else a
+    bm = b[::-1] if tb else b
+    return in_shapes, [tuple(am[:-1] + bm[1:])], []
+
+
+@register("dot", inputs=("lhs", "rhs"), params=dict(_DOT_SPEC), infer_shape=_dot_infer)
+def _dot(opctx, attrs, a, b):
+    if attrs.get("transpose_a", False):
+        a = a.T
+    if attrs.get("transpose_b", False):
+        b = b.T
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b).reshape((1,))
+    return jnp.dot(a, b)
+
+
+def _batch_dot_infer(attrs, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        return in_shapes, [None], []
+    ta, tb = attrs.get("transpose_a", False), attrs.get("transpose_b", False)
+    m = a[2] if ta else a[1]
+    n = b[1] if tb else b[2]
+    return in_shapes, [(a[0], m, n)], []
+
+
+@register("batch_dot", inputs=("lhs", "rhs"), params=dict(_DOT_SPEC),
+          infer_shape=_batch_dot_infer)
+def _batch_dot(opctx, attrs, a, b):
+    if attrs.get("transpose_a", False):
+        a = jnp.swapaxes(a, -1, -2)
+    if attrs.get("transpose_b", False):
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Concat / SliceChannel / Pad / Crop
+# ---------------------------------------------------------------------------
+
+
+def _concat_infer(attrs, in_shapes):
+    dim = attrs.get("dim", 1)
+    known = [s for s in in_shapes if s is not None]
+    if not known:
+        return in_shapes, [None], []
+    base = list(known[0])
+    total = 0
+    for s in in_shapes:
+        if s is None:
+            return in_shapes, [None], []
+        total += s[dim]
+    base[dim] = total
+    return in_shapes, [tuple(base)], []
+
+
+@register("Concat", aliases=("concat",), key_var_num_args="num_args",
+          params={"num_args": Param(int, required=True), "dim": Param(int, 1)},
+          infer_shape=_concat_infer, hint="concat")
+def _concat(opctx, attrs, *args):
+    return jnp.concatenate(args, axis=attrs.get("dim", 1))
+
+
+def _slice_channel_outputs(attrs):
+    return int(attrs.get("num_outputs", 1))
+
+
+def _slice_channel_infer(attrs, in_shapes):
+    (ishape,) = in_shapes
+    n = int(attrs.get("num_outputs", 1))
+    if ishape is None:
+        return in_shapes, [None] * n, []
+    axis = attrs.get("axis", 1) % len(ishape)
+    out = list(ishape)
+    out[axis] //= n
+    if attrs.get("squeeze_axis") and out[axis] == 1:
+        del out[axis]
+    return in_shapes, [tuple(out)] * n, []
+
+
+@register("SliceChannel", aliases=("split",),
+          params={"num_outputs": Param(int, required=True), "axis": Param(int, 1),
+                  "squeeze_axis": Param(bool, False)},
+          num_outputs=_slice_channel_outputs, infer_shape=_slice_channel_infer,
+          hint="slicechannel")
+def _slice_channel(opctx, attrs, x):
+    n = int(attrs["num_outputs"])
+    axis = attrs.get("axis", 1) % x.ndim
+    parts = jnp.split(x, n, axis=axis)
+    if attrs.get("squeeze_axis"):
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+def _pad_infer(attrs, in_shapes):
+    (ishape,) = in_shapes
+    if ishape is None:
+        return in_shapes, [None], []
+    pw = attrs["pad_width"]
+    out = tuple(ishape[i] + pw[2 * i] + pw[2 * i + 1] for i in range(len(ishape)))
+    return in_shapes, [out], []
+
+
+@register("Pad", aliases=("pad",),
+          params={"mode": Param(str, "constant", enum=("constant", "edge", "reflect")),
+                  "pad_width": Param("shape", required=True),
+                  "constant_value": Param(float, 0.0)},
+          infer_shape=_pad_infer, hint="pad")
+def _pad(opctx, attrs, x):
+    pw = attrs["pad_width"]
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(x.ndim)]
+    mode = attrs.get("mode", "constant")
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=attrs.get("constant_value", 0.0))
+    return jnp.pad(x, pairs, mode=mode)
+
+
+def _crop_inputs(attrs):
+    return ["data", "crop_like"] if int(attrs.get("num_args", 1)) == 2 else ["data"]
+
+
+@register("Crop", inputs=_crop_inputs,
+          params={"num_args": Param(int, 1), "offset": Param("shape", (0, 0)),
+                  "h_w": Param("shape", (0, 0)), "center_crop": Param(bool, False)},
+          no_grad_inputs=("crop_like",), hint="crop")
+def _crop_op(opctx, attrs, x, *rest):
+    """Spatial crop on NCHW (reference: src/operator/crop.cc)."""
+    if rest:
+        th, tw = rest[0].shape[2], rest[0].shape[3]
+    else:
+        th, tw = attrs["h_w"]
+    h, w = x.shape[2], x.shape[3]
+    if attrs.get("center_crop"):
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = attrs.get("offset", (0, 0))
+    return x[:, :, oy:oy + th, ox:ox + tw]

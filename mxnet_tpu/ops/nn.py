@@ -1,0 +1,749 @@
+"""Neural-network layer ops — the legacy ``MXNET_REGISTER_OP_PROPERTY`` layer
+surface of the reference, re-built on jax.numpy / lax so XLA owns fusion and
+MXU mapping (replacing mshadow expressions + cuDNN dispatch, e.g.
+/root/reference/src/operator/fully_connected-inl.h:81,
+src/operator/convolution.cu:18-44).
+
+Loss "Output" ops reproduce the reference's backward semantics exactly via
+``jax.custom_vjp`` (they ignore head gradients — they ARE the loss):
+  * SoftmaxOutput:  grad = (softmax - onehot) * grad_scale / normalizer,
+    ignore_label masking (src/operator/softmax_output-inl.h:106-220)
+  * {Linear,Logistic,MAE}RegressionOutput: grad = grad_scale / num_output *
+    BackwardOp(out, label) (src/operator/regression_output-inl.h:56-80)
+  * MakeLoss: grad = grad_scale (src/operator/make_loss-inl.h)
+  * SVMOutput: hinge-loss grad (src/operator/svm_output-inl.h)
+
+Layer params (kernel/stride/pad tuples, NCHW layouts, fix_gamma defaults)
+match the reference's dmlc::Parameter declarations so graph JSON and script
+kwargs carry over unchanged.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .param import Param, _np_dtype
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+
+@register("Activation",
+          params={"act_type": Param(str, required=True,
+                                    enum=("relu", "sigmoid", "tanh", "softrelu"))},
+          hint="activation")
+def _activation(opctx, attrs, x):
+    t = attrs["act_type"]
+    if t == "relu":
+        return jax.nn.relu(x)
+    if t == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if t == "tanh":
+        return jnp.tanh(x)
+    return jax.nn.softplus(x)  # softrelu
+
+
+def _leaky_inputs(attrs):
+    if attrs.get("act_type", "leaky") == "prelu":
+        return ["data", "gamma"]
+    return ["data"]
+
+
+def _leaky_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if attrs.get("act_type", "leaky") == "prelu":
+        g = (d[1],) if d is not None else in_shapes[1]
+        return [d, g], [d], []
+    return in_shapes, [d], []
+
+
+@register("LeakyReLU", inputs=_leaky_inputs,
+          params={"act_type": Param(str, "leaky", enum=("rrelu", "leaky", "prelu", "elu")),
+                  "slope": Param(float, 0.25),
+                  "lower_bound": Param(float, 0.125), "upper_bound": Param(float, 0.334)},
+          infer_shape=_leaky_infer, stochastic=True, hint="leakyrelu")
+def _leaky_relu(opctx, attrs, x, *rest):
+    t = attrs.get("act_type", "leaky")
+    if t == "leaky":
+        return jnp.where(x > 0, x, attrs.get("slope", 0.25) * x)
+    if t == "elu":
+        s = attrs.get("slope", 0.25)
+        return jnp.where(x > 0, x, s * jnp.expm1(x))
+    if t == "prelu":
+        gamma = rest[0].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x > 0, x, gamma * x)
+    # rrelu: random slope in train, mean slope in eval
+    lo, up = attrs.get("lower_bound", 0.125), attrs.get("upper_bound", 0.334)
+    if opctx.is_train and opctx.rng is not None:
+        slope = jax.random.uniform(opctx.rng, x.shape, x.dtype, lo, up)
+    else:
+        slope = (lo + up) / 2.0
+    return jnp.where(x > 0, x, slope * x)
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected — dot(data, W^T) + b on the MXU
+# ---------------------------------------------------------------------------
+
+
+def _fc_inputs(attrs):
+    return ["data", "weight"] if attrs.get("no_bias") else ["data", "weight", "bias"]
+
+
+def _fc_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    nh = attrs["num_hidden"]
+    if d is None:
+        return in_shapes, [None], []
+    in_dim = int(np.prod(d[1:])) if len(d) > 1 else 1
+    shapes = [d, (nh, in_dim)]
+    if not attrs.get("no_bias"):
+        shapes.append((nh,))
+    return shapes, [(d[0], nh)], []
+
+
+@register("FullyConnected", inputs=_fc_inputs,
+          params={"num_hidden": Param(int, required=True), "no_bias": Param(bool, False),
+                  "flatten": Param(bool, True)},
+          infer_shape=_fc_infer, hint="fullyconnected")
+def _fully_connected(opctx, attrs, data, weight, *rest):
+    if data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = jnp.dot(data, weight.T)
+    if rest:
+        out = out + rest[0]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+_CONV_SPEC = {
+    "kernel": Param("shape", required=True),
+    "stride": Param("shape", ()),
+    "dilate": Param("shape", ()),
+    "pad": Param("shape", ()),
+    "num_filter": Param(int, required=True),
+    "num_group": Param(int, 1),
+    "workspace": Param(int, 1024),
+    "no_bias": Param(bool, False),
+    "cudnn_tune": Param(str, ""),
+    "cudnn_off": Param(bool, False),
+    "layout": Param(str, ""),
+}
+
+
+def _conv_inputs(attrs):
+    return ["data", "weight"] if attrs.get("no_bias") else ["data", "weight", "bias"]
+
+
+def _tup(v, nd, default):
+    if not v:
+        return (default,) * nd
+    return tuple(v)
+
+
+def _conv_out_dim(x, k, s, p, d):
+    return (x + 2 * p - (d * (k - 1) + 1)) // s + 1
+
+
+def _conv_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, [None], []
+    kernel = attrs["kernel"]
+    nd = len(kernel)
+    stride = _tup(attrs.get("stride"), nd, 1)
+    pad = _tup(attrs.get("pad"), nd, 0)
+    dil = _tup(attrs.get("dilate"), nd, 1)
+    nf, ng = attrs["num_filter"], attrs.get("num_group", 1)
+    wshape = (nf, data[1] // ng) + tuple(kernel)
+    shapes = [data, wshape] + ([] if attrs.get("no_bias") else [(nf,)])
+    spatial = tuple(
+        _conv_out_dim(data[2 + i], kernel[i], stride[i], pad[i], dil[i])
+        for i in range(nd)
+    )
+    return shapes, [(data[0], nf) + spatial], []
+
+
+def _conv_dnums(nd):
+    spec = "NCHW"[: 2 + nd] if nd <= 2 else "NCDHW"
+    lhs = "NC" + "DHW"[-nd:]
+    out = lhs
+    rhs = "OI" + "DHW"[-nd:]
+    del spec
+    return lax.conv_dimension_numbers((1, 1) + (1,) * nd, (1, 1) + (1,) * nd,
+                                      (lhs, rhs, out))
+
+
+@register("Convolution", inputs=_conv_inputs, params=dict(_CONV_SPEC),
+          infer_shape=_conv_infer, aliases=("Convolution_v1",), hint="convolution")
+def _convolution(opctx, attrs, data, weight, *rest):
+    kernel = attrs["kernel"]
+    nd = len(kernel)
+    stride = _tup(attrs.get("stride"), nd, 1)
+    pad = _tup(attrs.get("pad"), nd, 0)
+    dil = _tup(attrs.get("dilate"), nd, 1)
+    dn = _conv_dnums(nd)
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dil,
+        dimension_numbers=dn, feature_group_count=attrs.get("num_group", 1),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    )
+    if out.dtype != data.dtype:
+        out = out.astype(data.dtype)
+    if rest:
+        bias = rest[0].reshape((1, -1) + (1,) * nd)
+        out = out + bias
+    return out
+
+
+_DECONV_SPEC = dict(_CONV_SPEC)
+_DECONV_SPEC.update({
+    "adj": Param("shape", ()),
+    "target_shape": Param("shape", ()),
+})
+
+
+def _deconv_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, [None], []
+    kernel = attrs["kernel"]
+    nd = len(kernel)
+    stride = _tup(attrs.get("stride"), nd, 1)
+    pad = _tup(attrs.get("pad"), nd, 0)
+    adj = _tup(attrs.get("adj"), nd, 0)
+    nf, ng = attrs["num_filter"], attrs.get("num_group", 1)
+    wshape = (data[1], nf // ng) + tuple(kernel)
+    shapes = [data, wshape] + ([] if attrs.get("no_bias") else [(nf,)])
+    tgt = attrs.get("target_shape")
+    if tgt:
+        spatial = tuple(tgt)
+    else:
+        spatial = tuple(
+            stride[i] * (data[2 + i] - 1) + kernel[i] - 2 * pad[i] + adj[i]
+            for i in range(nd)
+        )
+    return shapes, [(data[0], nf) + spatial], []
+
+
+@register("Deconvolution", inputs=_conv_inputs, params=dict(_DECONV_SPEC),
+          infer_shape=_deconv_infer, hint="deconvolution")
+def _deconvolution(opctx, attrs, data, weight, *rest):
+    """Transposed convolution: lhs-dilated conv with the flipped, IO-swapped
+    kernel (reference: src/operator/deconvolution-inl.h — implemented there as
+    the backward of Convolution)."""
+    kernel = attrs["kernel"]
+    nd = len(kernel)
+    stride = _tup(attrs.get("stride"), nd, 1)
+    pad = _tup(attrs.get("pad"), nd, 0)
+    adj = _tup(attrs.get("adj"), nd, 0)
+    ng = attrs.get("num_group", 1)
+    nf = attrs["num_filter"]
+    c = data.shape[1]
+    # weight (C, F/g, *k) -> grouped OIHW (F, C/g, *k), spatially flipped
+    w = weight.reshape((ng, c // ng, nf // ng) + tuple(kernel))
+    w = jnp.swapaxes(w, 1, 2).reshape((nf, c // ng) + tuple(kernel))
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    dn = _conv_dnums(nd)
+    padding = [
+        (kernel[i] - 1 - pad[i], kernel[i] - 1 - pad[i] + adj[i]) for i in range(nd)
+    ]
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=stride, dimension_numbers=dn, feature_group_count=ng,
+    )
+    if rest:
+        out = out + rest[0].reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+_POOL_SPEC = {
+    "kernel": Param("shape", required=True),
+    "pool_type": Param(str, "max", enum=("max", "avg", "sum")),
+    "global_pool": Param(bool, False),
+    "pooling_convention": Param(str, "valid", enum=("valid", "full")),
+    "stride": Param("shape", ()),
+    "pad": Param("shape", ()),
+    "cudnn_off": Param(bool, False),
+}
+
+
+def _pool_out_dim(x, k, s, p, full):
+    if full:
+        return int(np.ceil((x + 2 * p - k) / s)) + 1
+    return (x + 2 * p - k) // s + 1
+
+
+def _pool_infer(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, [None], []
+    nd = len(data) - 2
+    if attrs.get("global_pool"):
+        return in_shapes, [tuple(data[:2]) + (1,) * nd], []
+    kernel = attrs["kernel"]
+    stride = _tup(attrs.get("stride"), nd, 1)
+    pad = _tup(attrs.get("pad"), nd, 0)
+    full = attrs.get("pooling_convention", "valid") == "full"
+    spatial = tuple(
+        _pool_out_dim(data[2 + i], kernel[i], stride[i], pad[i], full)
+        for i in range(nd)
+    )
+    return in_shapes, [tuple(data[:2]) + spatial], []
+
+
+@register("Pooling", params=dict(_POOL_SPEC), infer_shape=_pool_infer,
+          aliases=("Pooling_v1",), hint="pooling")
+def _pooling(opctx, attrs, x):
+    nd = x.ndim - 2
+    ptype = attrs.get("pool_type", "max")
+    if attrs.get("global_pool"):
+        kernel = x.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    else:
+        kernel = tuple(attrs["kernel"])
+        stride = _tup(attrs.get("stride"), nd, 1)
+        pad = _tup(attrs.get("pad"), nd, 0)
+    full = attrs.get("pooling_convention", "valid") == "full"
+    # explicit padding achieving the reference's output-size convention
+    pads = []
+    for i in range(nd):
+        out = _pool_out_dim(x.shape[2 + i], kernel[i], stride[i], pad[i], full)
+        need = max((out - 1) * stride[i] + kernel[i] - x.shape[2 + i], 0)
+        pads.append((pad[i], max(need - pad[i], 0)))
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = [(0, 0), (0, 0)] + pads
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, jnp.array(init, x.dtype), lax.max, window,
+                                 strides, padding)
+    summed = lax.reduce_window(x, jnp.array(0, x.dtype), lax.add, window,
+                               strides, padding)
+    if ptype == "sum":
+        return summed
+    # avg: reference divides by full window size (count_include_pad semantics
+    # of mshadow pool, src/operator/pooling-inl.h)
+    return summed / np.prod(kernel)
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm — aux states (moving_mean/moving_var) threaded functionally
+# ---------------------------------------------------------------------------
+
+
+def _bn_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None, None, None], []
+    c = (d[1] if len(d) > 1 else d[0],)
+    nout = 3 if attrs.get("output_mean_var") else 1
+    outs = [tuple(d)] + ([c, c] if nout == 3 else [])
+    return [d, c, c], outs, [c, c]
+
+
+@register("BatchNorm", inputs=("data", "gamma", "beta"),
+          aux=("moving_mean", "moving_var"),
+          params={"eps": Param(float, 1e-3), "momentum": Param(float, 0.9),
+                  "fix_gamma": Param(bool, True), "use_global_stats": Param(bool, False),
+                  "output_mean_var": Param(bool, False)},
+          num_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1,
+          infer_shape=_bn_infer, aliases=("CuDNNBatchNorm",), hint="batchnorm")
+def _batch_norm(opctx, attrs, data, gamma, beta, moving_mean, moving_var):
+    eps = attrs.get("eps", 1e-3)
+    momentum = attrs.get("momentum", 0.9)
+    fix_gamma = attrs.get("fix_gamma", True)
+    use_global = attrs.get("use_global_stats", False) or not opctx.is_train
+    axes = (0,) + tuple(range(2, data.ndim))
+    bshape = (1, -1) + (1,) * (data.ndim - 2) if data.ndim > 1 else (-1,)
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    if use_global:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    else:
+        mean = jnp.mean(data, axis=axes)
+        var = jnp.var(data, axis=axes)
+        new_mm = momentum * moving_mean + (1 - momentum) * lax.stop_gradient(mean)
+        new_mv = momentum * moving_var + (1 - momentum) * lax.stop_gradient(var)
+    inv = lax.rsqrt(var.reshape(bshape) + eps)
+    out = (data - mean.reshape(bshape)) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
+    if attrs.get("output_mean_var"):
+        return out, mean, var, new_mm, new_mv
+    return out, new_mm, new_mv
+
+
+@register("InstanceNorm", inputs=("data", "gamma", "beta"),
+          params={"eps": Param(float, 1e-3)},
+          infer_shape=lambda attrs, s: (
+              [s[0], (s[0][1],), (s[0][1],)] if s[0] is not None else s,
+              [s[0]], []),
+          hint="instancenorm")
+def _instance_norm(opctx, attrs, data, gamma, beta):
+    eps = attrs.get("eps", 1e-3)
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register("L2Normalization",
+          params={"eps": Param(float, 1e-10),
+                  "mode": Param(str, "instance", enum=("instance", "spatial", "channel"))},
+          hint="l2normalization")
+def _l2_normalization(opctx, attrs, x):
+    eps = attrs.get("eps", 1e-10)
+    mode = attrs.get("mode", "instance")
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, x.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / norm
+
+
+@register("LRN", params={"alpha": Param(float, 1e-4), "beta": Param(float, 0.75),
+                         "knorm": Param(float, 2.0), "nsize": Param(int, required=True)},
+          hint="lrn")
+def _lrn(opctx, attrs, x):
+    """Cross-channel local response norm (reference: src/operator/lrn-inl.h)."""
+    nsize = attrs["nsize"]
+    alpha, beta, knorm = attrs.get("alpha", 1e-4), attrs.get("beta", 0.75), attrs.get("knorm", 2.0)
+    sq = jnp.square(x)
+    half = nsize // 2
+    pads = [(0, 0)] * x.ndim
+    pads[1] = (half, half)
+    window = [1] * x.ndim
+    window[1] = nsize
+    ssum = lax.reduce_window(sq, jnp.array(0, x.dtype), lax.add, tuple(window),
+                             (1,) * x.ndim, pads)
+    return x * jnp.power(knorm + alpha / nsize * ssum, -beta)
+
+
+# ---------------------------------------------------------------------------
+# Dropout
+# ---------------------------------------------------------------------------
+
+
+@register("Dropout", params={"p": Param(float, 0.5)}, stochastic=True, hint="dropout")
+def _dropout(opctx, attrs, x):
+    p = attrs.get("p", 0.5)
+    if not opctx.is_train or p <= 0.0 or opctx.rng is None:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(opctx.rng, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+# ---------------------------------------------------------------------------
+# Loss output ops (custom vjp; ignore head gradients)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _softmax_output_impl(data, label, grad_scale, ignore_label, multi_output,
+                         use_ignore, normalization):
+    axis = 1 if multi_output else -1
+    return jax.nn.softmax(data, axis=axis)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, multi_output,
+                        use_ignore, normalization):
+    out = jax.nn.softmax(data, axis=1 if multi_output else -1)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, multi_output, use_ignore,
+                        normalization, res, ct):
+    del ct  # loss op: head gradient ignored (softmax_output-inl.h:131)
+    out, label = res
+    axis = 1 if multi_output else -1
+    nclass = out.shape[axis]
+    ilabel = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(ilabel, nclass, dtype=out.dtype, axis=axis)
+    grad = out - onehot
+    valid = jnp.ones(label.shape, out.dtype)
+    if use_ignore:
+        valid = (label != ignore_label).astype(out.dtype)
+        grad = grad * jnp.expand_dims(valid, axis if multi_output else -1)
+    if normalization == "batch":
+        norm = label.shape[0]
+    elif normalization == "valid":
+        norm = jnp.maximum(jnp.sum(valid), 1.0)
+    else:
+        norm = 1.0
+    grad = grad * (grad_scale / norm)
+    return grad.astype(out.dtype), jnp.zeros_like(label)
+
+
+_softmax_output_impl.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+def _softmax_label_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    if attrs.get("multi_output"):
+        lshape = (d[0],) + tuple(d[2:])
+    else:
+        lshape = tuple(d[:-1]) if len(d) > 1 else (d[0],)
+    return [d, lshape], [tuple(d)], []
+
+
+@register("SoftmaxOutput", inputs=("data", "label"),
+          params={"grad_scale": Param(float, 1.0), "ignore_label": Param(float, -1.0),
+                  "multi_output": Param(bool, False), "use_ignore": Param(bool, False),
+                  "preserve_shape": Param(bool, False),
+                  "normalization": Param(str, "null", enum=("null", "batch", "valid")),
+                  "out_grad": Param(bool, False)},
+          infer_shape=_softmax_label_infer, no_grad_inputs=("label",),
+          aliases=("Softmax",), hint="softmaxoutput")
+def _softmax_output(opctx, attrs, data, label):
+    return _softmax_output_impl(
+        data, label, attrs.get("grad_scale", 1.0), attrs.get("ignore_label", -1.0),
+        bool(attrs.get("multi_output", False)), bool(attrs.get("use_ignore", False)),
+        attrs.get("normalization", "null"))
+
+
+@register("SoftmaxActivation",
+          params={"mode": Param(str, "instance", enum=("instance", "channel"))},
+          hint="softmaxactivation")
+def _softmax_activation(opctx, attrs, x):
+    if attrs.get("mode", "instance") == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+def _make_regression(name, fwd_fn, bwd_fn):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def impl(data, label, grad_scale):
+        return fwd_fn(data)
+
+    def fwd(data, label, grad_scale):
+        out = fwd_fn(data)
+        return out, (out, label)
+
+    def bwd(grad_scale, res, ct):
+        del ct  # regression_output-inl.h:56-80 — head grad ignored
+        out, label = res
+        num_output = int(np.prod(label.shape[1:])) if label.ndim > 1 else 1
+        g = bwd_fn(out, label.reshape(out.shape)) * (grad_scale / num_output)
+        return g.astype(out.dtype), jnp.zeros_like(label)
+
+    impl.defvjp(fwd, bwd)
+
+    def label_infer(attrs, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        if len(d) == 2 and d[1] == 1:
+            lshape = (d[0],)
+        else:
+            lshape = tuple(d)
+        return [d, lshape], [tuple(d)], []
+
+    @register(name, inputs=("data", "label"),
+              params={"grad_scale": Param(float, 1.0)},
+              infer_shape=label_infer, no_grad_inputs=("label",),
+              hint=name.lower())
+    def _op(opctx, attrs, data, label):
+        return impl(data, label, attrs.get("grad_scale", 1.0))
+
+
+_make_regression("LinearRegressionOutput", lambda d: d, lambda o, l: o - l)
+_make_regression("LogisticRegressionOutput", jax.nn.sigmoid, lambda o, l: o - l)
+_make_regression("MAERegressionOutput", lambda d: d, lambda o, l: jnp.sign(o - l))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_output_impl(data, label, margin, coef, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, coef, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, coef, use_linear, res, ct):
+    del ct
+    data, label = res
+    n, c = data.shape[0], data.shape[-1]
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), c, dtype=data.dtype)
+    sign = 1 - 2 * onehot  # -1 at the true class, +1 elsewhere
+    dist = margin - data * (2 * onehot - 1)
+    viol = (dist > 0).astype(data.dtype)
+    if use_linear:
+        grad = coef * sign * viol
+    else:
+        grad = 2 * coef * sign * viol * dist
+    return grad.astype(data.dtype), jnp.zeros_like(label)
+
+
+_svm_output_impl.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register("SVMOutput", inputs=("data", "label"),
+          params={"margin": Param(float, 1.0),
+                  "regularization_coefficient": Param(float, 1.0),
+                  "use_linear": Param(bool, False)},
+          infer_shape=_softmax_label_infer, no_grad_inputs=("label",),
+          hint="svmoutput")
+def _svm_output(opctx, attrs, data, label):
+    return _svm_output_impl(data, label, attrs.get("margin", 1.0),
+                            attrs.get("regularization_coefficient", 1.0),
+                            bool(attrs.get("use_linear", False)))
+
+
+@register("MakeLoss",
+          params={"grad_scale": Param(float, 1.0), "valid_thresh": Param(float, 0.0),
+                  "normalization": Param(str, "null", enum=("null", "batch", "valid"))},
+          hint="makeloss")
+def _make_loss_layer(opctx, attrs, x):
+    """Legacy MakeLoss layer (src/operator/make_loss-inl.h): identity forward,
+    constant grad_scale backward with batch/valid normalization."""
+    gs = attrs.get("grad_scale", 1.0)
+    norm = attrs.get("normalization", "null")
+    thresh = attrs.get("valid_thresh", 0.0)
+
+    @jax.custom_vjp
+    def impl(x):
+        return x
+
+    def fwd(x):
+        return x, x
+
+    def bwd(res, ct):
+        del ct
+        x = res
+        if norm == "batch":
+            scale = gs / x.shape[0]
+            return (jnp.full(x.shape, scale, x.dtype),)
+        if norm == "valid":
+            valid = jnp.maximum(jnp.sum((x > thresh).astype(x.dtype)), 1.0)
+            return (jnp.full(x.shape, gs, x.dtype) / valid,)
+        return (jnp.full(x.shape, gs, x.dtype),)
+
+    impl.defvjp(fwd, bwd)
+    return impl(x)
+
+
+@register("softmax_cross_entropy", inputs=("data", "label"),
+          no_grad_inputs=("label",),
+          infer_shape=lambda attrs, s: (s, [(1,)], []))
+def _softmax_cross_entropy(opctx, attrs, data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1], dtype=data.dtype)
+    return -jnp.sum(onehot * logp).reshape((1,))
+
+
+@register("IdentityAttachKLSparseReg",
+          aux=("moving_avg",),
+          params={"sparseness_target": Param(float, 0.1),
+                  "penalty": Param(float, 0.001), "momentum": Param(float, 0.9)},
+          infer_shape=lambda attrs, s: (
+              s, [s[0]], [(s[0][1],) if s[0] is not None else None]),
+          hint="identityattachklsparsereg")
+def _identity_kl_sparse(opctx, attrs, data, moving_avg):
+    """Identity with KL-sparsity gradient penalty on the (sigmoid) activations
+    (reference: src/operator/identity_attach_KL_sparse_reg-inl.h)."""
+    st = attrs.get("sparseness_target", 0.1)
+    pen = attrs.get("penalty", 0.001)
+    mom = attrs.get("momentum", 0.9)
+    rho = jnp.mean(data, axis=tuple(i for i in range(data.ndim) if i != 1))
+    new_avg = mom * moving_avg + (1 - mom) * lax.stop_gradient(rho)
+
+    @jax.custom_vjp
+    def impl(x, rho_hat):
+        return x
+
+    def fwd(x, rho_hat):
+        return x, (x.shape, x.dtype, rho_hat)
+
+    def bwd(res, ct):
+        shape, dtype, rho_hat = res
+        kl_grad = pen * (-st / (rho_hat + 1e-12) + (1 - st) / (1 - rho_hat + 1e-12))
+        bshape = (1, -1) + (1,) * (len(shape) - 2)
+        return (ct + kl_grad.reshape(bshape).astype(dtype), jnp.zeros_like(rho_hat))
+
+    impl.defvjp(fwd, bwd)
+    return impl(data, lax.stop_gradient(rho)), new_avg
+
+
+# ---------------------------------------------------------------------------
+# UpSampling
+# ---------------------------------------------------------------------------
+
+
+def _upsampling_inputs(attrs):
+    n = int(attrs.get("num_args", 1))
+    if attrs.get("sample_type") == "bilinear":
+        return ["data", "weight"]
+    return ["arg%d" % i for i in range(n)] if n > 1 else ["data"]
+
+
+def _upsampling_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    s = attrs["scale"]
+    out = (d[0], sum(x[1] for x in in_shapes if x is not None) if len(in_shapes) > 1
+           and attrs.get("sample_type") != "bilinear" else d[1], d[2] * s, d[3] * s)
+    if attrs.get("sample_type") == "bilinear":
+        k = 2 * s - s % 2
+        return [d, (d[1], 1, k, k)], [out], []
+    return in_shapes, [out], []
+
+
+@register("UpSampling", inputs=_upsampling_inputs, key_var_num_args="num_args",
+          params={"scale": Param(int, required=True), "num_filter": Param(int, 0),
+                  "sample_type": Param(str, required=True, enum=("nearest", "bilinear")),
+                  "multi_input_mode": Param(str, "concat", enum=("concat", "sum")),
+                  "num_args": Param(int, 1), "workspace": Param(int, 512)},
+          infer_shape=_upsampling_infer, hint="upsampling")
+def _upsampling(opctx, attrs, *args):
+    s = attrs["scale"]
+    stype = attrs["sample_type"]
+    if stype == "nearest":
+        outs = []
+        for x in args:
+            up = jnp.repeat(jnp.repeat(x, s, axis=2), s, axis=3)
+            outs.append(up)
+        if len(outs) == 1:
+            return outs[0]
+        if attrs.get("multi_input_mode", "concat") == "sum":
+            out = outs[0]
+            for o in outs[1:]:
+                out = out + o
+            return out
+        return jnp.concatenate(outs, axis=1)
+    # bilinear: grouped deconvolution with the provided weight
+    data, weight = args
+    c = data.shape[1]
+    k = 2 * s - s % 2
+    pad = (s - 1) // 2 if s % 2 else s // 2  # int(ceil((s-1)/2)) symmetric-ish
+    dn = _conv_dnums(2)
+    w = jnp.flip(weight, axis=(2, 3))
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1, 1),
+        padding=[(k - 1 - pad, k - 1 - pad)] * 2,
+        lhs_dilation=(s, s), dimension_numbers=dn, feature_group_count=c)
+    return out

@@ -1,0 +1,108 @@
+"""Ordering ops: sort / argsort / topk.
+
+Parity surface: /root/reference/src/operator/tensor/ordering_op-inl.h.
+``topk`` keeps the reference's ret_typ variants (value/indices/mask/both) and
+float index outputs.  lax.top_k / XLA sort replace the reference's
+per-row mergesort kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .param import Param
+from .registry import register
+
+_SORT_SPEC = {"axis": Param("int-or-none", -1), "is_ascend": Param(bool, True)}
+
+
+@register("sort", params=dict(_SORT_SPEC))
+def _sort(opctx, attrs, x):
+    axis = attrs.get("axis", -1)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = -1
+    out = jnp.sort(x, axis=axis)
+    if not attrs.get("is_ascend", True):
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", params=dict(_SORT_SPEC), no_grad_inputs=("data",))
+def _argsort(opctx, attrs, x):
+    axis = attrs.get("axis", -1)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = -1
+    idx = jnp.argsort(x, axis=axis)
+    if not attrs.get("is_ascend", True):
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(x.dtype)
+
+
+_TOPK_SPEC = {
+    "axis": Param("int-or-none", -1),
+    "k": Param(int, 1),
+    "ret_typ": Param(str, "indices", enum=("value", "indices", "mask", "both")),
+    "is_ascend": Param(bool, False),
+}
+
+
+def _topk_outputs(attrs):
+    return 2 if attrs.get("ret_typ", "indices") == "both" else 1
+
+
+def _topk_infer(attrs, in_shapes):
+    (ishape,) = in_shapes
+    n = _topk_outputs(attrs)
+    if ishape is None:
+        return in_shapes, [None] * n, []
+    axis = attrs.get("axis", -1)
+    k = attrs.get("k", 1)
+    ret = attrs.get("ret_typ", "indices")
+    if ret == "mask":
+        return in_shapes, [tuple(ishape)], []
+    if axis is None:
+        out = (k,)
+    else:
+        out = list(ishape)
+        out[axis % len(ishape)] = k
+        out = tuple(out)
+    return in_shapes, [out] * n, []
+
+
+@register("topk", params=dict(_TOPK_SPEC), num_outputs=_topk_outputs,
+          infer_shape=_topk_infer, no_grad_inputs=("data",))
+def _topk(opctx, attrs, x):
+    axis = attrs.get("axis", -1)
+    k = int(attrs.get("k", 1))
+    asc = attrs.get("is_ascend", False)
+    ret = attrs.get("ret_typ", "indices")
+    orig_shape = x.shape
+    if axis is None:
+        xm = x.reshape(1, -1)
+        axis_ = 1
+    else:
+        axis_ = axis % x.ndim
+        xm = jnp.moveaxis(x, axis_, -1)
+    vals, idx = jax.lax.top_k(-xm if asc else xm, k)
+    if asc:
+        vals = -vals
+    if ret == "mask":
+        mask = jnp.zeros_like(xm).at[
+            tuple(jnp.indices(idx.shape)[:-1]) + (idx,)
+        ].set(1.0)
+        if axis is None:
+            return mask.reshape(orig_shape)
+        return jnp.moveaxis(mask, -1, axis_)
+    if axis is None:
+        vals, idx = vals.reshape(-1), idx.reshape(-1)
+    else:
+        vals = jnp.moveaxis(vals, -1, axis_)
+        idx = jnp.moveaxis(idx, -1, axis_)
+    fidx = idx.astype(x.dtype)
+    if ret == "value":
+        return vals
+    if ret == "indices":
+        return fidx
+    return vals, fidx

@@ -1,0 +1,148 @@
+"""Operator registry — single source of truth for the op surface.
+
+TPU-native redesign of the reference's three-generation op machinery
+(``MXNET_REGISTER_OP_PROPERTY`` legacy layers, ``NNVM_REGISTER_OP`` FCompute
+tensor ops, and ``MXNET_REGISTER_SIMPLE_OP`` — see
+include/mxnet/operator.h:77-480 and include/mxnet/op_attr_types.h:33-63 in
+/root/reference).  Here there is ONE registration form: a pure function over
+``jax.numpy`` arrays plus declarative metadata.  The registry drives
+
+* the auto-generated imperative API (``mx.nd.<op>``) — analogue of the
+  reference's import-time codegen from the C op registry
+  (python/mxnet/_ctypes/ndarray.py:165-200),
+* the symbolic API (``mx.sym.<op>``) and graph JSON round-trip,
+* shape/type inference (per-op ``infer_shape`` for ops that can deduce
+  parameter shapes; jax.eval_shape as the fallback oracle),
+* autodiff: gradients come from JAX tracing through ``fn`` — custom
+  gradients (loss ops, stop-gradient semantics) are expressed with
+  ``jax.custom_vjp`` inside ``fn`` instead of hand-written ``_backward_*``
+  ops.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Op", "OpContext", "register", "get_op", "list_ops", "registered_ops"]
+
+
+class OpContext:
+    """Per-invocation context handed to op kernels (reference: OpContext in
+    include/mxnet/operator.h:60-75 — is_train + requested resources).  The
+    RNG resource (reference: ResourceManager ResourceRandom, src/resource.cc:144)
+    is a JAX PRNG key, split per stochastic op by the caller."""
+
+    __slots__ = ("is_train", "rng")
+
+    def __init__(self, is_train: bool = False, rng=None):
+        self.is_train = is_train
+        self.rng = rng
+
+
+class Op:
+    def __init__(
+        self,
+        name: str,
+        fn: Callable,
+        inputs: Any = ("data",),
+        params: Optional[Dict[str, Any]] = None,
+        num_outputs: Any = 1,
+        aux: Sequence[str] = (),
+        stochastic: bool = False,
+        key_var_num_args: Optional[str] = None,
+        infer_shape: Optional[Callable] = None,
+        infer_type: Optional[Callable] = None,
+        output_names: Optional[Callable] = None,
+        hint: Optional[str] = None,
+        no_grad_inputs: Sequence[str] = (),
+        doc: str = "",
+    ):
+        self.name = name
+        self.fn = fn
+        self._inputs = inputs
+        self.params = params or {}
+        self._num_outputs = num_outputs
+        self.aux = tuple(aux)
+        self.stochastic = stochastic
+        self.key_var_num_args = key_var_num_args
+        self.infer_shape = infer_shape
+        self.infer_type = infer_type
+        self._output_names = output_names
+        self.hint = hint or name.lower().lstrip("_")
+        self.no_grad_inputs = tuple(no_grad_inputs)
+        self.doc = doc
+
+    # -- metadata ----------------------------------------------------------
+    def input_names(self, attrs: Dict[str, Any]) -> List[str]:
+        if callable(self._inputs):
+            return list(self._inputs(attrs))
+        if self.key_var_num_args and self.key_var_num_args in attrs:
+            n = int(attrs[self.key_var_num_args])
+            return ["arg%d" % i for i in range(n)]
+        return list(self._inputs)
+
+    def num_outputs(self, attrs: Dict[str, Any]) -> int:
+        if callable(self._num_outputs):
+            return int(self._num_outputs(attrs))
+        return int(self._num_outputs)
+
+    def output_names(self, attrs: Dict[str, Any], node_name: str) -> List[str]:
+        if self._output_names is not None:
+            names = self._output_names(attrs)
+            return ["%s_%s" % (node_name, n) for n in names]
+        n = self.num_outputs(attrs)
+        if n == 1:
+            return ["%s_output" % node_name]
+        return ["%s_output%d" % (node_name, i) for i in range(n)]
+
+    def parse_attrs(self, attrs: Dict[str, Any]) -> Dict[str, Any]:
+        from .param import parse_attrs
+
+        return parse_attrs(self.params, attrs, self.name)
+
+    # -- application -------------------------------------------------------
+    def apply(self, opctx: OpContext, attrs: Dict[str, Any], inputs, aux=()):
+        """Run the kernel.  Returns (outputs: tuple, aux_updates: tuple)."""
+        result = self.fn(opctx, attrs, *inputs, *aux)
+        if not isinstance(result, tuple):
+            result = (result,)
+        n_out = self.num_outputs(attrs)
+        n_aux = len(self.aux)
+        if n_aux and len(result) == n_out + n_aux:
+            return result[:n_out], result[n_out:]
+        return result, tuple(aux)
+
+    def __repr__(self):
+        return "Op(%s)" % self.name
+
+
+_REGISTRY: Dict[str, Op] = {}
+
+
+def register(name: str, **kwargs) -> Callable:
+    """Decorator registering an op kernel.  ``aliases`` registers extra names
+    pointing at the same Op (reference keeps e.g. both ``Flatten`` and
+    ``flatten``)."""
+    aliases = kwargs.pop("aliases", ())
+
+    def deco(fn: Callable) -> Callable:
+        op = Op(name, fn, doc=fn.__doc__ or "", **kwargs)
+        _REGISTRY[name] = op
+        for a in aliases:
+            _REGISTRY[a] = op
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> Op:
+    if name not in _REGISTRY:
+        raise KeyError("Operator %s is not registered" % name)
+    return _REGISTRY[name]
+
+
+def list_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def registered_ops() -> Dict[str, Op]:
+    return _REGISTRY

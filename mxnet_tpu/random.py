@@ -1,0 +1,75 @@
+"""Random number handling (parity: python/mxnet/random.py + the RNG resource
+ResourceRandom in /root/reference/src/resource.cc:144).
+
+A single seeded JAX PRNG stream is split per stochastic op call — the
+functional TPU replacement for per-device cuRAND generators.  ``seed()``
+reseeds the stream exactly like ``mx.random.seed``.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["seed", "next_key", "uniform", "normal", "randint", "current_seed"]
+
+_state = threading.local()
+
+
+def _ensure():
+    if not hasattr(_state, "key"):
+        import jax
+
+        _state.seed = 0
+        _state.key = jax.random.PRNGKey(0)
+    return _state
+
+
+def seed(seed_state: int) -> None:
+    """Seed the global random stream (parity: mx.random.seed; reference also
+    reseeds numpy-side augmenters, so we touch np.random too)."""
+    import jax
+
+    st = _ensure()
+    st.seed = int(seed_state)
+    st.key = jax.random.PRNGKey(int(seed_state))
+    np.random.seed(int(seed_state) % (2 ** 32))
+
+
+def current_seed() -> int:
+    return _ensure().seed
+
+
+def next_key():
+    """Split one fresh key off the global stream."""
+    import jax
+
+    st = _ensure()
+    st.key, sub = jax.random.split(st.key)
+    return sub
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), ctx=None, out=None, dtype="float32"):
+    from . import ndarray as nd
+
+    return nd.uniform(low=low, high=high, shape=shape, ctx=ctx, out=out, dtype=dtype)
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), ctx=None, out=None, dtype="float32"):
+    from . import ndarray as nd
+
+    return nd.normal(loc=loc, scale=scale, shape=shape, ctx=ctx, out=out, dtype=dtype)
+
+
+def randint(low, high, shape=(1,), ctx=None, out=None, dtype="int32"):
+    import jax
+
+    from . import ndarray as nd
+
+    key = next_key()
+    data = jax.random.randint(key, shape, low, high)
+    arr = nd.array(np.asarray(data), ctx=ctx, dtype=dtype)
+    if out is not None:
+        out[:] = arr
+        return out
+    return arr

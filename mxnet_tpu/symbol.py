@@ -1,0 +1,696 @@
+"""Symbol — the symbolic graph layer.
+
+TPU-native redesign of the reference's nnvm-based Symbol
+(python/mxnet/symbol.py + nnvm graph IR, /root/reference
+src/c_api/c_api_symbolic.cc).  A Symbol is a list of output entries of an
+immutable DAG of ``_Node``s.  Instead of nnvm passes, the graph lowers to a
+pure JAX function (see executor.py) — autodiff, memory planning, fusion and
+placement are XLA's job (SURVEY.md §7 architecture mapping).
+
+Kept API surface: composition with auto-created parameter variables and
+NameManager naming, ``infer_shape``/``infer_shape_partial`` with parameter
+shape filling (reference InferShape pass semantics), ``infer_type``,
+``list_arguments/outputs/auxiliary_states``, ``Group``, slicing, attr
+scoping (``__ctx_group__`` etc. via AttrScope), JSON save/load compatible
+with the reference's graph JSON (nodes/"op": "null" variables/arg_nodes/
+heads), and ``bind``/``simple_bind``.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .attribute import AttrScope
+from .base import MXNetError
+from .name import NameManager
+from .ops import OpContext, get_op, registered_ops
+from .ops.param import attrs_to_strs
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "pow", "maximum", "minimum", "ones", "zeros", "arange"]
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "attr_dict", "_aux_names")
+
+    def __init__(self, op, name: str, attrs: Dict[str, Any],
+                 inputs: List[Tuple["_Node", int]], attr_dict: Dict[str, str]):
+        self.op = op  # None for variables
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        self.attr_dict = dict(attr_dict or {})
+        self._aux_names = None
+
+    @property
+    def is_variable(self) -> bool:
+        return self.op is None
+
+    def num_outputs(self) -> int:
+        return 1 if self.op is None else self.op.num_outputs(self.attrs)
+
+    def aux_names(self) -> List[str]:
+        if self.op is None or not self.op.aux:
+            return []
+        if self._aux_names is None:
+            self._aux_names = ["%s_%s" % (self.name, a) for a in self.op.aux]
+        return self._aux_names
+
+
+def _topo_sort(heads: Sequence[Tuple[_Node, int]]) -> List[_Node]:
+    order: List[_Node] = []
+    seen = set()
+
+    def visit(node: _Node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for parent, _ in node.inputs:
+            visit(parent)
+        order.append(node)
+
+    for node, _ in heads:
+        visit(node)
+    return order
+
+
+class Symbol:
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs: List[Tuple[_Node, int]]):
+        self._outputs = list(outputs)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    @property
+    def name(self) -> Optional[str]:
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def _nodes(self) -> List[_Node]:
+        return _topo_sort(self._outputs)
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._nodes() if n.is_variable]
+
+    def list_outputs(self) -> List[str]:
+        names = []
+        for node, idx in self._outputs:
+            if node.is_variable:
+                names.append(node.name)
+            else:
+                names.append(node.op.output_names(node.attrs, node.name)[idx])
+        return names
+
+    def list_auxiliary_states(self) -> List[str]:
+        out = []
+        for n in self._nodes():
+            out.extend(n.aux_names())
+        return out
+
+    def get_internals(self) -> "Symbol":
+        entries = []
+        for n in self._nodes():
+            for i in range(n.num_outputs()):
+                entries.append((n, i))
+        return Symbol(entries)
+
+    def get_children(self) -> Optional["Symbol"]:
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise ValueError("Cannot find output %s" % index)
+            index = names.index(index)
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        for i in range(len(self._outputs)):
+            yield self[i]
+
+    def __repr__(self):
+        name = self.name
+        return "<Symbol %s>" % (name if name else "Grouped")
+
+    # ------------------------------------------------------------------
+    # attributes
+    # ------------------------------------------------------------------
+    def attr(self, key: str) -> Optional[str]:
+        node = self._outputs[0][0]
+        return node.attr_dict.get(key)
+
+    def list_attr(self, recursive=False) -> Dict[str, str]:
+        if recursive:
+            out = {}
+            for n in self._nodes():
+                for k, v in n.attr_dict.items():
+                    out["%s_%s" % (n.name, k)] = v
+            return out
+        return dict(self._outputs[0][0].attr_dict)
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        out = {}
+        for n in self._nodes():
+            d = dict(n.attr_dict)
+            if n.op is not None:
+                d.update(attrs_to_strs({k: v for k, v in n.attrs.items()
+                                        if k in n.op.params}))
+            if d:
+                out[n.name] = d
+        return out
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0].attr_dict.update(kwargs)
+
+    # ------------------------------------------------------------------
+    # arithmetic
+    # ------------------------------------------------------------------
+    def _binary(self, other, op_name, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _create(op_name, [a, b], {})
+        if np.isscalar(other):
+            return _create(scalar_op, [self], {"scalar": float(other)})
+        raise TypeError("unsupported operand type %s" % type(other))
+
+    def __add__(self, o):
+        return self._binary(o, "_Plus", "_PlusScalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "_Minus", "_MinusScalar")
+
+    def __rsub__(self, o):
+        return self._binary(o, "_Minus", "_RMinusScalar", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "_Mul", "_MulScalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "_Div", "_DivScalar")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "_Div", "_RDivScalar", reverse=True)
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, o):
+        return self._binary(o, "_Power", "_PowerScalar")
+
+    def __neg__(self):
+        return _create("negative", [self], {})
+
+    def __eq__(self, o):
+        if isinstance(o, (Symbol, int, float)):
+            return self._binary(o, "_equal", "_equal_scalar")
+        return NotImplemented
+
+    def __ne__(self, o):
+        if isinstance(o, (Symbol, int, float)):
+            return self._binary(o, "_not_equal", "_not_equal_scalar")
+        return NotImplemented
+
+    def __gt__(self, o):
+        return self._binary(o, "_greater", "_greater_scalar")
+
+    def __ge__(self, o):
+        return self._binary(o, "_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, o):
+        return self._binary(o, "_lesser", "_lesser_scalar")
+
+    def __le__(self, o):
+        return self._binary(o, "_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # ------------------------------------------------------------------
+    # shape / type inference
+    # ------------------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux_shapes = self._infer_shape_impl(args, kwargs)
+        if arg_shapes is not None and any(s is None for s in arg_shapes + out_shapes):
+            return None, None, None
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(args, kwargs)
+
+    def _infer_shape_impl(self, args, kwargs):
+        arg_names = self.list_arguments()
+        known: Dict[str, Tuple[int, ...]] = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        for k, v in kwargs.items():
+            if v is not None:
+                known[k] = tuple(v)
+        entry_shapes, aux_shapes = _forward_infer(
+            self, {k: (tuple(v), None) for k, v in known.items()})
+        arg_out = []
+        for n in self._nodes():
+            if n.is_variable:
+                st = entry_shapes.get((id(n), 0))
+                arg_out.append(st[0] if st else None)
+        out_out = []
+        for node, idx in self._outputs:
+            st = entry_shapes.get((id(node), idx))
+            out_out.append(st[0] if st else None)
+        aux_out = []
+        for n in self._nodes():
+            for aname in n.aux_names():
+                aux_out.append(aux_shapes.get(aname))
+        return arg_out, out_out, aux_out
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known: Dict[str, Any] = {}
+        if args:
+            for name, t in zip(arg_names, args):
+                if t is not None:
+                    known[name] = np.dtype(t)
+        for k, v in kwargs.items():
+            if v is not None:
+                known[k] = np.dtype(v)
+        # types propagate through the same machinery, carried next to shapes
+        shapes_needed = {k: (None, v) for k, v in known.items()}
+        entry_info, _aux = _forward_infer(self, shapes_needed, types_only=True)
+        arg_out = [None] * len(arg_names)
+        for i, n in enumerate(n for n in self._nodes() if n.is_variable):
+            st = entry_info.get((id(n), 0))
+            arg_out[i] = st[1] if st else None
+        default = np.dtype(np.float32)
+        arg_out = [t if t is not None else default for t in arg_out]
+        out_out = []
+        for node, idx in self._outputs:
+            st = entry_info.get((id(node), idx))
+            out_out.append(st[1] if st and st[1] is not None else default)
+        aux_out = [default for n in self._nodes() for _ in n.aux_names()]
+        return arg_out, out_out, aux_out
+
+    # ------------------------------------------------------------------
+    # save / load (reference graph JSON format)
+    # ------------------------------------------------------------------
+    def tojson(self) -> str:
+        nodes = self._nodes()
+        node_index = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        arg_nodes = []
+        for i, n in enumerate(nodes):
+            if n.is_variable:
+                arg_nodes.append(i)
+                jnodes.append({"op": "null", "name": n.name,
+                               "attr": dict(n.attr_dict), "inputs": []})
+            else:
+                attr = attrs_to_strs({k: v for k, v in n.attrs.items()
+                                      if n.op.params and k in n.op.params})
+                attr.update(n.attr_dict)
+                jnodes.append({
+                    "op": n.op.name, "name": n.name, "attr": attr,
+                    "inputs": [[node_index[id(p)], int(idx), 0]
+                               for p, idx in n.inputs]})
+        heads = [[node_index[id(node)], int(idx), 0] for node, idx in self._outputs]
+        return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": list(range(len(nodes) + 1)),
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 901]}}, indent=2)
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ------------------------------------------------------------------
+    # binding
+    # ------------------------------------------------------------------
+    def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+             group2ctx=None, shared_exec=None):
+        from .executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    def simple_bind(self, ctx, grad_req="write", type_dict=None, group2ctx=None,
+                    shared_exec=None, **kwargs):
+        from . import ndarray as nd
+        from .executor import Executor
+
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise ValueError("Cannot infer shapes: provide input shapes")
+        type_dict = type_dict or {}
+        arg_types, _, aux_types = self.infer_type(**{
+            k: v for k, v in type_dict.items()})
+        args = [nd.zeros(s, ctx, dtype=t) for s, t in zip(arg_shapes, arg_types)]
+        aux = [nd.zeros(s, ctx, dtype=t) for s, t in zip(aux_shapes, aux_types)]
+        grad_req_dict = grad_req if isinstance(grad_req, dict) else {}
+        args_grad = {}
+        for name, s, t in zip(self.list_arguments(), arg_shapes, arg_types):
+            req = grad_req_dict.get(name, grad_req) if grad_req_dict else grad_req
+            if req != "null":
+                args_grad[name] = nd.zeros(s, ctx, dtype=t)
+        return Executor(self, ctx, args, args_grad or None, grad_req, aux,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    # evaluation sugar
+    def eval(self, ctx=None, **kwargs):
+        from .context import current_context
+
+        ctx = ctx or current_context()
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    # grad of outputs wrt wrt-args as a new executor-level helper
+    def grad(self, wrt: Sequence[str]) -> "Symbol":
+        raise MXNetError(
+            "Symbol.grad is not supported: gradients come from Executor.backward "
+            "(JAX autodiff), matching deprecated status in the reference")
+
+
+# ---------------------------------------------------------------------------
+# forward inference over the graph (shapes + dtypes)
+# ---------------------------------------------------------------------------
+
+
+def _forward_infer(sym: Symbol, known: Dict[str, Tuple], types_only=False):
+    """Propagate (shape, dtype) through the graph.  ``known`` maps variable
+    name -> (shape or None, dtype or None).  Per-op infer_shape functions may
+    fill unknown *input* shapes (parameter shape deduction, mirroring the
+    reference's bidirectional InferShape pass)."""
+    import jax
+
+    nodes = _topo_sort(sym._outputs)
+    info: Dict[Tuple[int, int], Tuple] = {}
+    aux_shapes: Dict[str, Tuple] = {}
+
+    for n in nodes:
+        if n.is_variable:
+            shape, dtype = known.get(n.name, (None, None))
+            if shape is None:
+                sattr = n.attr_dict.get("__shape__")
+                if sattr:
+                    import ast
+
+                    shape = tuple(ast.literal_eval(sattr))
+            if dtype is None:
+                dattr = n.attr_dict.get("__dtype__")
+                if dattr:
+                    dtype = np.dtype(dattr)
+            info[(id(n), 0)] = (shape, dtype)
+
+    changed = True
+    passes = 0
+    while changed and passes < 3:
+        changed = False
+        passes += 1
+        for n in nodes:
+            if n.is_variable:
+                continue
+            in_entries = [(id(p), idx) for p, idx in n.inputs]
+            in_infos = [info.get(e, (None, None)) for e in in_entries]
+            in_shapes = [s for s, _ in in_infos]
+            in_dtypes = [t for _, t in in_infos]
+            nout = n.num_outputs()
+            have_all_out = all(
+                info.get((id(n), i), (None, None))[0] is not None
+                for i in range(nout)) if not types_only else all(
+                info.get((id(n), i), (None, None))[1] is not None
+                for i in range(nout))
+            # 1) per-op shape inference (may fill parameter shapes)
+            if n.op.infer_shape is not None and not types_only:
+                try:
+                    new_in, out_shapes, aux = n.op.infer_shape(n.attrs, in_shapes)
+                except Exception:
+                    new_in, out_shapes, aux = in_shapes, [None] * nout, []
+                for e, old, new in zip(in_entries, in_shapes, new_in):
+                    if new is not None and old is None:
+                        old_info = info.get(e, (None, None))
+                        info[e] = (tuple(new), old_info[1])
+                        changed = True
+                for i, s in enumerate(out_shapes):
+                    if s is not None:
+                        old_info = info.get((id(n), i), (None, None))
+                        if old_info[0] is None:
+                            info[(id(n), i)] = (tuple(s), old_info[1])
+                            changed = True
+                for aname, ashape in zip(n.aux_names(), aux):
+                    if ashape is not None and aname not in aux_shapes:
+                        aux_shapes[aname] = tuple(ashape)
+                        changed = True
+            # 2) full eval_shape when every input is fully known
+            in_infos = [info.get(e, (None, None)) for e in in_entries]
+            full = all(s is not None for s, _ in in_infos)
+            if full and not have_all_out:
+                structs = [
+                    jax.ShapeDtypeStruct(s, t if t is not None else np.float32)
+                    for s, t in in_infos]
+                n_aux = len(n.op.aux)
+                if n_aux:
+                    known_aux = [aux_shapes.get(a) for a in n.aux_names()]
+                    if any(a is None for a in known_aux):
+                        continue
+                    structs += [jax.ShapeDtypeStruct(s, np.float32)
+                                for s in known_aux]
+                try:
+                    outs = _abstract_apply(n.op, n.attrs, structs)
+                except Exception:
+                    continue
+                for i in range(nout):
+                    cur = info.get((id(n), i), (None, None))
+                    new = (tuple(outs[i].shape), np.dtype(outs[i].dtype))
+                    if cur[0] is None or cur[1] is None:
+                        info[(id(n), i)] = new
+                        changed = True
+            # 3) dtype-only propagation (works without shapes, reference
+            # InferType pass semantics: same-dtype rule + dtype attrs)
+            in_infos = [info.get(e, (None, None)) for e in in_entries]
+            need_dtype = any(
+                info.get((id(n), i), (None, None))[1] is None for i in range(nout))
+            if need_dtype:
+                dt = None
+                if "dtype" in n.attrs and n.attrs.get("dtype") and \
+                        isinstance(n.attrs.get("dtype"), str):
+                    from .ops.param import _np_dtype
+
+                    try:
+                        dt = np.dtype(_np_dtype(n.attrs["dtype"]))
+                    except TypeError:
+                        dt = None
+                if dt is None:
+                    in_dts = [t for _, t in in_infos if t is not None]
+                    if in_dts and all(t is not None for _, t in in_infos):
+                        dt = np.result_type(*in_dts)
+                    elif not in_entries:
+                        dt = np.dtype(np.float32)
+                if dt is not None:
+                    for i in range(nout):
+                        s, t = info.get((id(n), i), (None, None))
+                        if t is None:
+                            info[(id(n), i)] = (s, dt)
+                            changed = True
+            # back-propagate dtypes to unknown-dtype variable inputs
+            out_dt = info.get((id(n), 0), (None, None))[1]
+            if out_dt is not None:
+                for (p, pidx), e in zip(n.inputs, in_entries):
+                    s, t = info.get(e, (None, None))
+                    if t is None and p.is_variable:
+                        info[e] = (s, out_dt)
+                        changed = True
+    return info, aux_shapes
+
+
+def _abstract_apply(op, attrs, structs):
+    import jax
+
+    n_aux = len(op.aux)
+
+    def fn(*arrs):
+        main = arrs[: len(arrs) - n_aux] if n_aux else arrs
+        aux = arrs[len(arrs) - n_aux:] if n_aux else ()
+        opctx = OpContext(is_train=False, rng=jax.random.PRNGKey(0))
+        outs, _ = op.apply(opctx, attrs, main, aux)
+        return outs
+
+    return jax.eval_shape(fn, *structs)
+
+
+# ---------------------------------------------------------------------------
+# symbol creation
+# ---------------------------------------------------------------------------
+
+
+def _create(op_name: str, sym_args: List[Symbol], kwargs: Dict[str, Any],
+            name: Optional[str] = None, attr: Optional[Dict[str, str]] = None):
+    op = get_op(op_name)
+    sym_kwargs = {}
+    attrs = {}
+    for k, v in kwargs.items():
+        if isinstance(v, Symbol):
+            sym_kwargs[k] = v
+        else:
+            attrs[k] = v
+    if op.key_var_num_args and op.key_var_num_args not in attrs and sym_args:
+        attrs[op.key_var_num_args] = len(sym_args)
+    parsed = op.parse_attrs(attrs)
+    name = NameManager.current().get(name, op.hint)
+    input_names = op.input_names(parsed)
+    slots: Dict[str, Symbol] = {}
+    for iname, s in zip(input_names, sym_args):
+        slots[iname] = s
+    for k, v in sym_kwargs.items():
+        if k not in input_names:
+            raise MXNetError("unknown input %s for op %s" % (k, op_name))
+        slots[k] = v
+    entries: List[Tuple[_Node, int]] = []
+    for iname in input_names:
+        s = slots.get(iname)
+        if s is None:
+            # auto-create parameter variable (reference composition semantics)
+            vnode = _Node(None, "%s_%s" % (name, iname), {},
+                          [], AttrScope.current().get(None))
+            entries.append((vnode, 0))
+        else:
+            if len(s._outputs) != 1:
+                raise MXNetError(
+                    "Cannot use grouped symbol as input %s of %s" % (iname, op_name))
+            entries.append(s._outputs[0])
+    attr_dict = AttrScope.current().get(attr)
+    node = _Node(op, name, parsed, entries, attr_dict)
+    return Symbol([(node, i) for i in range(op.num_outputs(parsed))])
+
+
+def _make_symbol_function(op_name: str, op):
+    def fn(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        sym_args = [a for a in args if isinstance(a, Symbol)]
+        return _create(op_name, sym_args, kwargs, name=name, attr=attr)
+
+    fn.__name__ = op_name
+    fn.__doc__ = op.doc or "Auto-generated symbol function for op %s" % op_name
+    return fn
+
+
+def Variable(name: str, attr=None, shape=None, dtype=None, init=None, **kwargs) -> Symbol:
+    """Create a named variable (placeholder) symbol."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    attr = AttrScope.current().get(attr)
+    if shape is not None:
+        attr["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        attr["__dtype__"] = np.dtype(dtype).name
+    if init is not None:
+        attr["__init__"] = init if isinstance(init, str) else init.dumps()
+    for k, v in kwargs.items():
+        attr["__%s__" % k] = str(v)
+    node = _Node(None, name, {}, [], attr)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    entries = []
+    for s in symbols:
+        entries.extend(s._outputs)
+    return Symbol(entries)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str: str) -> Symbol:
+    data = json.loads(json_str)
+    jnodes = data["nodes"]
+    nodes: List[_Node] = []
+    for jn in jnodes:
+        attr = jn.get("attr", jn.get("attrs", {})) or {}
+        if jn["op"] == "null":
+            nodes.append(_Node(None, jn["name"], {}, [], attr))
+        else:
+            op = get_op(jn["op"])
+            param_attrs = {k: v for k, v in attr.items() if not k.startswith("__")}
+            graph_attrs = {k: v for k, v in attr.items() if k.startswith("__")}
+            parsed = op.parse_attrs(param_attrs)
+            inputs = [(nodes[i[0]], i[1]) for i in jn["inputs"]]
+            nodes.append(_Node(op, jn["name"], parsed, inputs, graph_attrs))
+    heads = [(nodes[h[0]], h[1] if len(h) > 1 else 0) for h in data["heads"]]
+    return Symbol(heads)
+
+
+# convenience creators mirroring mx.sym.zeros/ones/arange
+def zeros(shape, dtype="float32", **kwargs):
+    return _create("_zeros", [], {"shape": shape, "dtype": dtype, **kwargs})
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _create("_ones", [], {"shape": shape, "dtype": dtype, **kwargs})
+
+
+def arange(start, stop=None, step=1.0, repeat=1, name=None, dtype="float32"):
+    return _create("_arange", [], {"start": start, "stop": stop, "step": step,
+                                   "repeat": repeat, "dtype": dtype}, name=name)
+
+
+def pow(base, exp):
+    if isinstance(base, Symbol) and isinstance(exp, Symbol):
+        return _create("_Power", [base, exp], {})
+    if isinstance(base, Symbol):
+        return base.__pow__(exp)
+    raise TypeError("pow expects Symbol base")
+
+
+def maximum(lhs, rhs):
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _create("_Maximum", [lhs, rhs], {})
+    s = lhs if isinstance(lhs, Symbol) else rhs
+    other = rhs if s is lhs else lhs
+    return _create("_MaximumScalar", [s], {"scalar": float(other)})
+
+
+def minimum(lhs, rhs):
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _create("_Minimum", [lhs, rhs], {})
+    s = lhs if isinstance(lhs, Symbol) else rhs
+    other = rhs if s is lhs else lhs
+    return _create("_MinimumScalar", [s], {"scalar": float(other)})
+
+
+def _init_symbol_module():
+    g = globals()
+    for name, op in registered_ops().items():
+        if name in g:
+            continue
+        g[name] = _make_symbol_function(name, op)
+
+
+_init_symbol_module()
