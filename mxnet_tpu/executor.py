@@ -289,6 +289,14 @@ class Executor:
             return
         add_names = tuple(sorted(
             n for n in diff_names if self._grad_req[n] == "add"))
+        # grad_req='add' accumulates into the existing gradient array; if the
+        # user bound none, start the accumulator at zero instead of failing
+        # with a KeyError inside the traced function.
+        for name in add_names:
+            if name not in self.grad_dict:
+                src = self.arg_dict[name]
+                self.grad_dict[name] = nd.zeros(src.shape, self._ctx,
+                                                dtype=src.dtype)
         args = {k: v._data for k, v in self.arg_dict.items()}
         aux = {k: v._data for k, v in self.aux_dict.items()}
         diff_args = {k: args[k] for k in diff_names}

@@ -168,6 +168,15 @@ class NDArray:
         return NDArray(self._data.T, self._ctx)
 
     def slice(self, start, stop) -> "NDArray":
+        """Return a sub-array over axis 0.
+
+        DOCUMENTED DEVIATION from the reference: ``Slice``/``__getitem__``
+        there return zero-copy aliases of the parent's storage
+        (include/mxnet/ndarray.h:286-352) so writes through a slice mutate
+        the parent.  ``jax.Array`` is immutable, so slices here are
+        independent copies; write into a region with ``a[i:j] = v`` on the
+        parent instead.  Covered by tests/unittest/test_ndarray.py.
+        """
         return NDArray(self._data[start:stop], self._ctx)
 
     def __len__(self):
@@ -326,6 +335,19 @@ class NDArray:
 # ---------------------------------------------------------------------------
 
 
+def _is_tensor_arg(v) -> bool:
+    """True for tensor-like kwargs (NDArray / ndarray / jax.Array).  numpy
+    scalars (``np.float32(2.0)``) carry dtype+shape but are attrs, not
+    tensor inputs."""
+    if isinstance(v, NDArray):
+        return True
+    if isinstance(v, np.generic):
+        return False
+    if isinstance(v, np.ndarray):
+        return True
+    return hasattr(v, "dtype") and hasattr(v, "shape") and hasattr(v, "ndim")
+
+
 def _invoke(op_name: str, args, kwargs):
     op = registered_ops()[op_name]
     out = kwargs.pop("out", None)
@@ -333,7 +355,7 @@ def _invoke(op_name: str, args, kwargs):
     nd_kwargs = {}
     attrs = {}
     for k, v in kwargs.items():
-        if isinstance(v, (NDArray, np.ndarray)) or hasattr(v, "dtype") and hasattr(v, "shape") and not np.isscalar(v):
+        if _is_tensor_arg(v):
             nd_kwargs[k] = v
         else:
             attrs[k] = v
@@ -515,10 +537,13 @@ def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3, mea
 
 def waitall():
     """Block until all async work completes (reference: Engine WaitForAll via
-    MXNDArrayWaitAll)."""
+    MXNDArrayWaitAll).  Blocks on every live ``jax.Array`` — the actual set of
+    outstanding async results — plus the effects token stream."""
     import jax
 
-    (jax.device_put(0.0) + 0).block_until_ready()
+    for a in jax.live_arrays():
+        a.block_until_ready()
+    jax.effects_barrier()
 
 
 # ---------------------------------------------------------------------------
@@ -527,9 +552,12 @@ def waitall():
 # ---------------------------------------------------------------------------
 
 _MAGIC = 0x112
-# mshadow type flags (mshadow/base.h enum order)
+# mshadow type flags (mshadow/base.h enum order).  bfloat16 has NO flag in the
+# reference enum: bf16 arrays are widened to float32 and saved as flag 0 so the
+# file stays readable by the reference implementation (documented deviation —
+# dtype is not round-tripped for bf16).
 _TYPE_FLAG = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3, "int32": 4,
-              "int8": 5, "int64": 6, "bfloat16": 7}
+              "int8": 5, "int64": 6}
 _FLAG_TYPE = {v: k for k, v in _TYPE_FLAG.items()}
 
 
@@ -542,14 +570,14 @@ def _save_one(f, arr: NDArray):
         return
     dev_type = arr.context.device_typeid
     f.write(struct.pack("<ii", dev_type, arr.context.device_id))
-    dtype_name = str(np.dtype(arr.dtype)) if arr.dtype != np.dtype("V2") else "bfloat16"
-    dtype_name = {"bfloat16": "bfloat16"}.get(dtype_name, dtype_name)
+    host = arr.asnumpy()
+    dtype_name = str(np.dtype(host.dtype)) if host.dtype.kind != "V" else "bfloat16"
     if dtype_name not in _TYPE_FLAG:
+        # bf16 (and any other type outside the reference enum) is widened to
+        # float32 and declared as flag 0 so the payload matches the header.
+        host = host.astype(np.float32)
         dtype_name = "float32"
     f.write(struct.pack("<i", _TYPE_FLAG[dtype_name]))
-    host = arr.asnumpy()
-    if dtype_name == "bfloat16":
-        host = host.astype(np.float32)  # bf16 stored widened for portability
     f.write(host.tobytes())
 
 
@@ -560,13 +588,32 @@ def _load_one(f) -> NDArray:
         return NDArray(np.zeros(()), cpu_ctx())
     dev_type, dev_id = struct.unpack("<ii", f.read(8))
     (type_flag,) = struct.unpack("<i", f.read(4))
-    dtype_name = _FLAG_TYPE.get(type_flag, "float32")
-    np_dtype = np.float32 if dtype_name == "bfloat16" else np.dtype(dtype_name)
+    if type_flag not in _FLAG_TYPE:
+        # guessing an element size here would desynchronize the stream and
+        # silently corrupt every subsequent array in the container
+        raise MXNetError("unknown mshadow type flag %d in .params file"
+                         % type_flag)
+    dtype_name = _FLAG_TYPE[type_flag]
+    np_dtype = np.dtype(dtype_name)
     count = int(np.prod(shape))
     buf = f.read(count * np_dtype.itemsize)
     host = np.frombuffer(buf, dtype=np_dtype).reshape(shape)
-    arr = array(host, dtype="bfloat16" if dtype_name == "bfloat16" else None)
-    return arr
+    # Preserve the stored dtype exactly (reference NDArray::Load keeps the
+    # type flag; array()'s float64->float32 default coercion must not apply).
+    # 64-bit dtypes need JAX x64 mode; without it warn instead of silently
+    # downcasting (TPUs have no native f64 — set JAX_ENABLE_X64=1 on CPU).
+    import jax
+
+    if np_dtype.itemsize == 8 and not jax.config.jax_enable_x64:
+        import warnings
+
+        warnings.warn(
+            "loading %s array as %s: JAX x64 mode is disabled "
+            "(set JAX_ENABLE_X64=1 to preserve 64-bit dtypes)"
+            % (dtype_name, "float32" if np_dtype.kind == "f" else "int32"))
+        np_dtype = np.dtype(np.float32 if np_dtype.kind == "f" else np.int32)
+        host = host.astype(np_dtype)
+    return array(host, dtype=np_dtype)
 
 
 def cpu_ctx():

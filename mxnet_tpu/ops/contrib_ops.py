@@ -100,17 +100,14 @@ def _mbp_infer(attrs, in_shapes):
 
 
 @register("_contrib_MultiBoxPrior",
-          params={"sizes": Param("shape", (1.0,)), "ratios": Param("shape", (1.0,)),
-                  "clip": Param(bool, False), "steps": Param("shape", (-1.0, -1.0)),
-                  "offsets": Param("shape", (0.5, 0.5))},
+          params={"sizes": Param("float-shape", (1.0,)), "ratios": Param("float-shape", (1.0,)),
+                  "clip": Param(bool, False), "steps": Param("float-shape", (-1.0, -1.0)),
+                  "offsets": Param("float-shape", (0.5, 0.5))},
           infer_shape=_mbp_infer, no_grad_inputs=("data",), hint="multibox_prior")
 def _multibox_prior(opctx, attrs, data):
-    # note: sizes/ratios parse through the shape parser; floats survive via
-    # ast.literal_eval in param._parse_shape when written as python tuples —
-    # re-read raw attrs to keep fractional values.
-    sizes = tuple(float(v) for v in _raw_tuple(attrs, "sizes", (1.0,)))
-    ratios = tuple(float(v) for v in _raw_tuple(attrs, "ratios", (1.0,)))
-    offy, offx = tuple(float(v) for v in _raw_tuple(attrs, "offsets", (0.5, 0.5)))
+    sizes = tuple(attrs.get("sizes") or (1.0,))
+    ratios = tuple(attrs.get("ratios") or (1.0,))
+    offy, offx = tuple(attrs.get("offsets") or (0.5, 0.5))
     h, w = data.shape[2], data.shape[3]
     cy = (jnp.arange(h) + offy) / h
     cx = (jnp.arange(w) + offx) / w
@@ -127,19 +124,6 @@ def _multibox_prior(opctx, attrs, data):
     if attrs.get("clip"):
         out = jnp.clip(out, 0.0, 1.0)
     return out.astype(data.dtype)
-
-
-def _raw_tuple(attrs, key, default):
-    v = attrs.get(key, default)
-    if v is None:
-        return default
-    if isinstance(v, str):
-        import ast
-
-        v = ast.literal_eval(v)
-    if isinstance(v, (int, float)):
-        return (v,)
-    return tuple(v)
 
 
 # ---------------------------------------------------------------------------
@@ -162,14 +146,13 @@ def _mbt_infer(attrs, in_shapes):
                   "negative_mining_ratio": Param(float, -1.0),
                   "negative_mining_thresh": Param(float, 0.5),
                   "minimum_negative_samples": Param(int, 0),
-                  "variances": Param("shape", (0.1, 0.1, 0.2, 0.2))},
+                  "variances": Param("float-shape", (0.1, 0.1, 0.2, 0.2))},
           num_outputs=3, infer_shape=_mbt_infer,
           no_grad_inputs=("anchor", "label", "cls_pred"),
           output_names=lambda attrs: ["loc_target", "loc_mask", "cls_target"],
           hint="multibox_target")
 def _multibox_target(opctx, attrs, anchor, label, cls_pred):
-    v0, v1, v2, v3 = tuple(float(v) for v in _raw_tuple(attrs, "variances",
-                                                        (0.1, 0.1, 0.2, 0.2)))
+    v0, v1, v2, v3 = tuple(attrs.get("variances") or (0.1, 0.1, 0.2, 0.2))
     thresh = attrs.get("overlap_threshold", 0.5)
     anchors = anchor.reshape(-1, 4)  # (A, 4)
     A = anchors.shape[0]
@@ -261,14 +244,13 @@ def _mbd_infer(attrs, in_shapes):
           params={"clip": Param(bool, True), "threshold": Param(float, 0.01),
                   "background_id": Param(int, 0), "nms_threshold": Param(float, 0.5),
                   "force_suppress": Param(bool, False),
-                  "variances": Param("shape", (0.1, 0.1, 0.2, 0.2)),
+                  "variances": Param("float-shape", (0.1, 0.1, 0.2, 0.2)),
                   "nms_topk": Param(int, -1)},
           infer_shape=_mbd_infer,
           no_grad_inputs=("cls_prob", "loc_pred", "anchor"),
           hint="multibox_detection")
 def _multibox_detection(opctx, attrs, cls_prob, loc_pred, anchor):
-    v0, v1, v2, v3 = tuple(float(v) for v in _raw_tuple(attrs, "variances",
-                                                        (0.1, 0.1, 0.2, 0.2)))
+    v0, v1, v2, v3 = tuple(attrs.get("variances") or (0.1, 0.1, 0.2, 0.2))
     anchors = anchor.reshape(-1, 4)
     A = anchors.shape[0]
     aw = anchors[:, 2] - anchors[:, 0]
@@ -317,16 +299,16 @@ def _proposal_infer(attrs, in_shapes):
                   "rpn_post_nms_top_n": Param(int, 300),
                   "threshold": Param(float, 0.7),
                   "rpn_min_size": Param(int, 16),
-                  "scales": Param("shape", (4, 8, 16, 32)),
-                  "ratios": Param("shape", (0.5, 1, 2)),
+                  "scales": Param("float-shape", (4, 8, 16, 32)),
+                  "ratios": Param("float-shape", (0.5, 1, 2)),
                   "feature_stride": Param(int, 16),
                   "output_score": Param(bool, False),
                   "iou_loss": Param(bool, False)},
           infer_shape=_proposal_infer,
           no_grad_inputs=("cls_prob", "bbox_pred", "im_info"), hint="proposal")
 def _proposal(opctx, attrs, cls_prob, bbox_pred, im_info):
-    scales = tuple(float(v) for v in _raw_tuple(attrs, "scales", (4, 8, 16, 32)))
-    ratios = tuple(float(v) for v in _raw_tuple(attrs, "ratios", (0.5, 1, 2)))
+    scales = tuple(attrs.get("scales") or (4.0, 8.0, 16.0, 32.0))
+    ratios = tuple(attrs.get("ratios") or (0.5, 1.0, 2.0))
     stride = attrs.get("feature_stride", 16)
     n, _, fh, fw = cls_prob.shape
     base = stride

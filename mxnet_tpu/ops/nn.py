@@ -363,7 +363,8 @@ def _bn_infer(attrs, in_shapes):
                   "fix_gamma": Param(bool, True), "use_global_stats": Param(bool, False),
                   "output_mean_var": Param(bool, False)},
           num_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1,
-          infer_shape=_bn_infer, aliases=("CuDNNBatchNorm",), hint="batchnorm")
+          infer_shape=_bn_infer, aliases=("CuDNNBatchNorm",), hint="batchnorm",
+          aux_dtype="float32")
 def _batch_norm(opctx, attrs, data, gamma, beta, moving_mean, moving_var):
     eps = attrs.get("eps", 1e-3)
     momentum = attrs.get("momentum", 0.9)

@@ -39,8 +39,9 @@ def _np_dtype(name):
 class Param:
     """One typed op parameter.
 
-    ``typ``: one of int, float, bool, 'shape' (tuple of ints), str,
-    'dtype', 'float-or-none', 'shape-or-none', 'int-or-none'.
+    ``typ``: one of int, float, bool, 'shape' (tuple of ints), 'float-shape'
+    (tuple of floats — no int coercion; use for sizes/ratios/variances/...),
+    str, 'dtype', 'float-or-none', 'shape-or-none', 'int-or-none'.
     """
 
     def __init__(self, typ, default=None, required=False, enum=None, doc=""):
@@ -56,6 +57,8 @@ class Param:
         t = self.typ
         if t == "shape" or t == "shape-or-none":
             return _parse_shape(value)
+        if t == "float-shape":
+            return _parse_shape(value, cast=float)
         if t is int or t == "int-or-none":
             if isinstance(value, str):
                 if value.lower() == "none":
@@ -88,20 +91,24 @@ class Param:
         return value
 
 
-def _parse_shape(value):
+def _parse_shape(value, cast=int):
     if isinstance(value, str):
         value = value.strip()
         if value.lower() in ("none", "()"):
             return tuple() if value == "()" else None
         parsed = ast.literal_eval(value)
         if isinstance(parsed, (int, float)):
-            return (int(parsed),)
-        return tuple(int(x) for x in parsed)
+            return (cast(parsed),)
+        return tuple(cast(x) for x in parsed)
     if isinstance(value, (int, np.integer)):
-        return (int(value),)
+        return (cast(value),)
+    if isinstance(value, (float, np.floating)):
+        if cast is not float:
+            raise TypeError("expected int or int tuple, got %r" % (value,))
+        return (cast(value),)
     if value is None:
         return None
-    return tuple(int(x) for x in value)
+    return tuple(cast(x) for x in value)
 
 
 def parse_attrs(spec: Optional[Dict[str, Param]], attrs: Dict[str, Any],

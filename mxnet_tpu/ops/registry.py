@@ -54,6 +54,7 @@ class Op:
         output_names: Optional[Callable] = None,
         hint: Optional[str] = None,
         no_grad_inputs: Sequence[str] = (),
+        aux_dtype: Optional[str] = None,
         doc: str = "",
     ):
         self.name = name
@@ -69,6 +70,9 @@ class Op:
         self._output_names = output_names
         self.hint = hint or name.lower().lstrip("_")
         self.no_grad_inputs = tuple(no_grad_inputs)
+        # aux states' dtype: None = follow the op's first input dtype;
+        # "float32" pins it (BatchNorm moving stats, reference semantics).
+        self.aux_dtype = aux_dtype
         self.doc = doc
 
     # -- metadata ----------------------------------------------------------

@@ -311,7 +311,21 @@ class Symbol:
         for node, idx in self._outputs:
             st = entry_info.get((id(node), idx))
             out_out.append(st[1] if st and st[1] is not None else default)
-        aux_out = [default for n in self._nodes() for _ in n.aux_names()]
+        # aux dtype: ops may pin it (BatchNorm moving stats stay float32 like
+        # the reference); otherwise it follows the node's first input dtype.
+        aux_out = []
+        for n in self._nodes():
+            if not n.aux_names():
+                continue
+            if n.op.aux_dtype is not None:
+                adt = np.dtype(n.op.aux_dtype)
+            else:
+                adt = default
+                if n.inputs:
+                    st = entry_info.get((id(n.inputs[0][0]), n.inputs[0][1]))
+                    if st and st[1] is not None:
+                        adt = st[1]
+            aux_out.extend([adt] * len(n.aux_names()))
         return arg_out, out_out, aux_out
 
     # ------------------------------------------------------------------
@@ -423,9 +437,12 @@ def _forward_infer(sym: Symbol, known: Dict[str, Tuple], types_only=False):
                     dtype = np.dtype(dattr)
             info[(id(n), 0)] = (shape, dtype)
 
+    # iterate to convergence like the reference InferShape pass; deep chains
+    # of parameter-shape deduction need more than a fixed handful of sweeps.
     changed = True
     passes = 0
-    while changed and passes < 3:
+    max_passes = max(10, 2 * len(nodes))
+    while changed and passes < max_passes:
         changed = False
         passes += 1
         for n in nodes:
@@ -638,8 +655,14 @@ def load_json(json_str: str) -> Symbol:
             nodes.append(_Node(None, jn["name"], {}, [], attr))
         else:
             op = get_op(jn["op"])
-            param_attrs = {k: v for k, v in attr.items() if not k.startswith("__")}
-            graph_attrs = {k: v for k, v in attr.items() if k.startswith("__")}
+            # Keys the op declares are parameters; everything else (user attrs
+            # set via AttrScope, e.g. lr_mult, or dunder graph attrs) passes
+            # through as node attributes instead of raising — matches the
+            # reference, where node attrs and op params share one string map.
+            param_attrs = {k: v for k, v in attr.items()
+                           if not k.startswith("__") and k in op.params}
+            graph_attrs = {k: v for k, v in attr.items()
+                           if k.startswith("__") or k not in op.params}
             parsed = op.parse_attrs(param_attrs)
             inputs = [(nodes[i[0]], i[1]) for i in jn["inputs"]]
             nodes.append(_Node(op, jn["name"], parsed, inputs, graph_attrs))
