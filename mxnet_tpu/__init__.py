@@ -1,9 +1,16 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the API surface of
+Apache MXNet 0.9 (reference: /root/reference), built on JAX/XLA.
+
+Import layout mirrors /root/reference/python/mxnet/__init__.py so reference
+user scripts port by changing only the import line.
+"""
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from .attribute import AttrScope
 from .name import NameManager, Prefix
 from . import random
+from . import random as rnd
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
@@ -12,3 +19,21 @@ from . import symbol as sym
 from .symbol import Symbol
 from . import executor
 from .executor import Executor
+from . import io
+from . import recordio
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import kvstore
+from . import kvstore as kv
+from . import model
+from . import module
+from . import module as mod
+from . import monitor
+from . import monitor as mon
+from . import visualization
+from . import visualization as viz
+from . import profiler

@@ -10,7 +10,7 @@ import io
 
 import numpy as np
 
-__all__ = ["decode_image", "resize_image", "HAVE_PIL"]
+__all__ = ["decode_image", "encode_image", "resize_image", "HAVE_PIL"]
 
 try:
     from PIL import Image
@@ -36,6 +36,22 @@ def decode_image(buf, channels: int = 3) -> np.ndarray:
     if arr.ndim == 2:
         arr = arr[:, :, None]
     return arr
+
+
+def encode_image(arr: np.ndarray, img_fmt: str = ".jpg", quality: int = 95) -> bytes:
+    """Encode an HWC uint8 array to JPEG/PNG bytes (reference pack_img uses
+    OpenCV imencode)."""
+    if not HAVE_PIL:
+        raise RuntimeError("No image encode backend available (PIL missing)")
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    img = Image.fromarray(arr[..., 0] if arr.ndim == 3 and arr.shape[-1] == 1
+                          else arr)
+    out = io.BytesIO()
+    if fmt == "JPEG":
+        img.save(out, fmt, quality=quality)
+    else:
+        img.save(out, fmt)
+    return out.getvalue()
 
 
 def resize_image(arr: np.ndarray, w: int, h: int, interp: int = 1) -> np.ndarray:

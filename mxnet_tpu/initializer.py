@@ -1,0 +1,370 @@
+"""Weight initializers.
+
+TPU-native counterpart of /root/reference/python/mxnet/initializer.py.
+API-compatible surface (Uniform/Normal/Orthogonal/Xavier/MSRAPrelu/Bilinear/
+LSTMBias/Load/Mixed, name-pattern dispatch via ``__call__``), but the random
+draws come from the framework's JAX PRNG stream (random.py) instead of the
+global numpy state, so initialization is reproducible under ``mx.random.seed``
+and runs on-device.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import re
+from math import sqrt
+from typing import Dict, Optional
+
+import numpy as np
+
+from .base import string_types
+
+__all__ = ["InitDesc", "Initializer", "Load", "Mixed", "Zero", "One",
+           "Constant", "Uniform", "Normal", "Orthogonal", "Xavier",
+           "MSRAPrelu", "Bilinear", "LSTMBias", "FusedRNN", "register"]
+
+_INIT_REGISTRY: Dict[str, type] = {}
+
+
+def register(klass):
+    """Register an initializer class under its lowercased name."""
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class InitDesc(str):
+    """Name + attrs describing how a variable asked to be initialized
+    (reference initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer: dispatches on parameter name suffix the same way the
+    reference does (initializer.py __call__)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self) -> str:
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, string_types):
+            raise TypeError("desc must be an initialization name (str/InitDesc)")
+        name = str(desc)
+        init = getattr(desc, "attrs", {}).get("__init__", "")
+        if init:
+            klass, kwargs = json.loads(init)
+            _INIT_REGISTRY[klass.lower()](**kwargs)._init_weight(name, arr)
+            return
+        if name.startswith("upsampling"):
+            self._init_bilinear(name, arr)
+        elif name.startswith("stn_loc") and name.endswith("weight"):
+            self._init_zero(name, arr)
+        elif name.startswith("stn_loc") and name.endswith("bias"):
+            self._init_loc_bias(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("moving_mean") or name.endswith("moving_avg"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_var") or name.endswith("moving_inv_var"):
+            self._init_one(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    # -- family defaults ---------------------------------------------------
+    def _init_bilinear(self, _, arr):
+        shape = arr.shape
+        weight = np.zeros(int(np.prod(shape)), dtype="float32")
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight.reshape(shape)
+
+    def _init_loc_bias(self, _, arr):
+        assert arr.shape[0] == 6
+        arr[:] = np.array([1.0, 0, 0, 0, 1.0, 0], dtype="float32")
+
+    def _init_zero(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_one(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_bias(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_gamma(self, _, arr):
+        arr[:] = 1.0
+
+    def _init_beta(self, _, arr):
+        arr[:] = 0.0
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("virtual _init_weight")
+
+    def _init_default(self, name, arr):
+        raise ValueError(
+            "Unknown initialization pattern for %s. Default initialization "
+            "covers parameters ending with weight/bias/gamma/beta; name "
+            "others explicitly or use Load/Mixed." % name)
+
+
+@register
+class Load:
+    """Initialize from an existing dict of arrays, falling back to
+    ``default_init`` (reference initializer.Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = dict(param)
+        # accept both raw dicts and arg:/aux: prefixed checkpoint dicts
+        for key in list(self.param):
+            if key.startswith("arg:") or key.startswith("aux:"):
+                self.param[key[4:]] = self.param.pop(key)
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        name = str(name)
+        if name in self.param:
+            src = self.param[name]
+            sshape = tuple(src.shape)
+            if sshape != tuple(arr.shape):
+                raise ValueError(
+                    "Parameter %s cannot be initialized from loading. Shape "
+                    "mismatch, target %s vs loaded %s"
+                    % (name, arr.shape, sshape))
+            arr[:] = src
+            if self.verbose:
+                logging.info("Initialized %s by loading", name)
+        else:
+            if self.default_init is None:
+                raise ValueError(
+                    "Cannot Initialize parameter %s. Not found in loaded "
+                    "param and no default initializer provided." % name)
+            self.default_init(name, arr)
+            if self.verbose:
+                logging.info("Initialized %s by default", name)
+
+
+@register
+class Mixed:
+    """Dispatch to different initializers by name regex (reference
+    initializer.Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        assert len(patterns) == len(initializers)
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError(
+            "Parameter name %s did not match any pattern. Consider adding a "
+            '".*" pattern at the end with default Initializer.' % name)
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 0.0
+
+    _init_default = _init_weight
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr[:] = 1.0
+
+    _init_default = _init_weight
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        arr[:] = self.value
+
+    _init_default = _init_weight
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) weights (reference initializer.Uniform)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        from . import random as _random
+
+        arr[:] = _random.uniform(-self.scale, self.scale, arr.shape)
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma) weights (reference initializer.Normal)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        from . import random as _random
+
+        arr[:] = _random.normal(0, self.sigma, arr.shape)
+
+
+@register
+class Orthogonal(Initializer):
+    """(Semi-)orthogonal matrix init via QR/SVD (Saxe et al;
+    reference initializer.Orthogonal)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        from . import random as _random
+
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _random.uniform(-1.0, 1.0, (nout, nin)).asnumpy()
+        else:
+            tmp = _random.normal(0.0, 1.0, (nout, nin)).asnumpy()
+        u, _v, q = np.linalg.svd(tmp, full_matrices=False)
+        res = u if u.shape == (nout, nin) else q
+        arr[:] = (self.scale * res).reshape(arr.shape)
+
+
+@register
+class Xavier(Initializer):
+    """Variance-scaling init (reference initializer.Xavier:344)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        from . import random as _random
+
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = 1.0
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = _random.uniform(-scale, scale, shape)
+        elif self.rnd_type == "gaussian":
+            arr[:] = _random.normal(0, scale, shape)
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He/MSRA init for PReLU nets (reference initializer.MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        Initializer._init_bilinear(self, name, arr)
+
+
+@register
+class LSTMBias(Initializer):
+    """Zero bias with forget gate bias set (reference initializer.LSTMBias).
+    Gate order i, f, c, o matches rnn_cell.LSTMCell."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = np.zeros(arr.shape, dtype="float32")
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = b
+
+    _init_bias = _init_weight
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize the single fused RNN parameter vector by unpacking it into
+    per-gate weights, applying ``init``, and repacking (reference
+    initializer.FusedRNN, backed by rnn_cell parameter layout here)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = _INIT_REGISTRY[klass.lower()](**kwargs)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        from .rnn.rnn_cell import FusedRNNCell
+
+        cell = FusedRNNCell(self._num_hidden, self._num_layers,
+                            self._mode, self._bidirectional,
+                            forget_bias=self._forget_bias)
+        args = cell.unpack_weights({str(name): arr.copy()})
+        for pname, parr in args.items():
+            desc = InitDesc(pname, getattr(name, "attrs", {}))
+            if self._init is None:
+                getattr(name, "global_init", None)(desc, parr)
+            else:
+                self._init(desc, parr)
+        packed = cell.pack_weights(args)
+        arr[:] = packed[str(name)]

@@ -1,0 +1,161 @@
+"""KVStore — parameter synchronization facade.
+
+TPU-native redesign of /root/reference/src/kvstore/ + python/mxnet/kvstore.py.
+The reference moves gradients through Comm (pinned-host or GPU-P2P reduce)
+and ps-lite; on TPU the synchronous data-parallel path is XLA collectives
+(``psum`` over a mesh axis) compiled *into* the training step, so ``local``
+and ``device`` collapse to the same thing: an aggregation point that applies
+the optimizer once per key.  The KVStore class keeps the reference's API
+(init/push/pull/set_optimizer/rank/num_workers) so Module and user scripts
+port unchanged; multi-host ``dist_*`` flavors ride ``jax.distributed`` +
+the global mesh (parallel/ package) rather than a parameter server.
+
+Push semantics match kvstore_local.h:50-95: pushed grads for one key are
+summed; with an updater installed the update runs eagerly on push and pull
+returns the stored weight; without one, pull returns the summed grads.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Callable, Dict, List, Optional, Union
+
+from .base import MXNetError
+from .ndarray import NDArray
+from . import ndarray as nd
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(key):
+    return (key if isinstance(key, (list, tuple)) else [key]), \
+        not isinstance(key, (list, tuple))
+
+
+def _val_list(value, nkeys):
+    if isinstance(value, (list, tuple)) and nkeys == 1 and \
+            not isinstance(value[0], (list, tuple)):
+        return [list(value)]
+    if nkeys == 1:
+        return [value if isinstance(value, list) else [value]]
+    out = []
+    for v in value:
+        out.append(v if isinstance(v, list) else [v])
+    return out
+
+
+class KVStore:
+    """Single-process key-value store (reference kvstore.h:26-286 'local' /
+    'device')."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store: Dict[Union[int, str], NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._optimizer = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        import jax
+
+        if "dist" in self._type:
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        import jax
+
+        if "dist" in self._type:
+            return jax.process_count()
+        return 1
+
+    # -- data plane --------------------------------------------------------
+    def init(self, key, value):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, v in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError("duplicate init of key %s" % str(k))
+            self._store[k] = v[0].copy() if isinstance(v[0], NDArray) \
+                else nd.array(v[0])
+
+    def push(self, key, value, priority=0):
+        """Sum pushed values per key; run the updater eagerly if installed
+        (reference KVStoreLocal::Push, kvstore_local.h:50)."""
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("push to uninitialized key %s" % str(k))
+            merged = vlist[0]
+            if len(vlist) > 1:
+                acc = vlist[0]._data
+                for v in vlist[1:]:
+                    acc = acc + v._data
+                merged = NDArray(acc, vlist[0].context)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k]._set(self._store[k]._data + merged._data)
+
+    def pull(self, key, out=None, priority=0):
+        keys, single = _key_list(key)
+        outs = _val_list(out, len(keys))
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("pull of uninitialized key %s" % str(k))
+            src = self._store[k]
+            for o in olist:
+                o._set(src._data.astype(o.dtype) if o.dtype != src.dtype
+                       else src._data)
+
+    # -- control plane -----------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Install an optimizer as the store-side updater.  In dist mode the
+        reference pickles it to the servers (kvstore.py:232-255); collective
+        DP needs no server, so both paths install locally."""
+        self._optimizer = optimizer
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def _barrier(self):
+        pass
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+def create(name="local") -> KVStore:
+    """Create a KVStore (reference KVStore::Create, kvstore.cc:17-45).
+    'local'/'device' → in-process aggregation (XLA fuses the reduce);
+    'dist_sync'/'dist_device_sync'/'dist_async' → same API over
+    jax.distributed (multi-host SPMD: sync semantics come from in-step
+    collectives, so dist_sync needs no server round-trips)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name = name.lower()
+    if name not in ("local", "local_update_cpu", "local_allreduce_cpu",
+                    "local_allreduce_device", "device", "dist_sync",
+                    "dist_device_sync", "dist_async", "dist"):
+        raise MXNetError("unknown KVStore type %s" % name)
+    return KVStore(name)

@@ -1,0 +1,388 @@
+"""Evaluation metrics (parity: /root/reference/python/mxnet/metric.py).
+
+EvalMetric.update takes lists of label/pred NDArrays.  ``asnumpy()`` here is
+THE hard sync point of the training loop (reference base_module.py:480 —
+metric update forces WaitToRead), so metrics are computed on host numpy.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy
+import numpy as np  # shadowed below by the np() factory; use `numpy` internally
+
+from .base import string_types
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy", "Loss",
+           "Torch", "CustomMetric", "np", "create", "check_label_shapes"]
+
+
+def check_label_shapes(labels, preds, shape=0):
+    if shape == 0:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError(
+            "Shape of labels %s does not match shape of predictions %s"
+            % (label_shape, pred_shape))
+
+
+class EvalMetric:
+    """Base metric accumulating (sum_metric, num_inst) (reference
+    metric.py:10-84)."""
+
+    def __init__(self, name, num=None):
+        self.name = name
+        self.num = num
+        self.reset()
+
+    def update(self, labels, preds):
+        raise NotImplementedError("virtual EvalMetric.update")
+
+    def reset(self):
+        if self.num is None:
+            self.num_inst = 0
+            self.sum_metric = 0.0
+        else:
+            self.num_inst = [0] * self.num
+            self.sum_metric = [0.0] * self.num
+
+    def get(self):
+        if self.num is None:
+            if self.num_inst == 0:
+                return (self.name, float("nan"))
+            return (self.name, self.sum_metric / self.num_inst)
+        names = ["%s_%d" % (self.name, i) for i in range(self.num)]
+        values = [x / y if y != 0 else float("nan")
+                  for x, y in zip(self.sum_metric, self.num_inst)]
+        return (names, values)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+
+class CompositeEvalMetric(EvalMetric):
+    """Manage multiple metrics as one (reference metric.py:86)."""
+
+    def __init__(self, **kwargs):
+        super().__init__("composite")
+        try:
+            self.metrics = kwargs["metrics"]
+        except KeyError:
+            self.metrics = []
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            return ValueError("Metric index {} is out of range 0 and {}".format(
+                index, len(self.metrics)))
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        try:
+            for metric in self.metrics:
+                metric.reset()
+        except AttributeError:
+            pass
+
+    def get(self):
+        names = []
+        results = []
+        for metric in self.metrics:
+            result = metric.get()
+            names.append(result[0])
+            results.append(result[1])
+        return (names, results)
+
+
+class Accuracy(EvalMetric):
+    """Classification accuracy (reference metric.py:132)."""
+
+    def __init__(self):
+        super().__init__("accuracy")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            pred = pred_label.asnumpy()
+            if pred.ndim > 1 and pred.shape != label.shape:
+                pred = numpy.argmax(pred, axis=1)
+            pred = pred.astype("int32")
+            label_np = label.asnumpy().astype("int32")
+            check_label_shapes(label_np, pred)
+            self.sum_metric += (pred.flat == label_np.flat).sum()
+            self.num_inst += len(pred.flat)
+
+
+class TopKAccuracy(EvalMetric):
+    """Top-k accuracy (reference metric.py:152)."""
+
+    def __init__(self, **kwargs):
+        super().__init__("top_k_accuracy")
+        try:
+            self.top_k = kwargs["top_k"]
+        except KeyError:
+            self.top_k = 1
+        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        self.name += "_%d" % self.top_k
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            assert len(pred_label.shape) <= 2, "Predictions should be no more than 2 dims"
+            pred = numpy.argsort(pred_label.asnumpy().astype("float32"), axis=1)
+            label_np = label.asnumpy().astype("int32")
+            check_label_shapes(label_np, pred)
+            num_samples = pred.shape[0]
+            num_dims = len(pred.shape)
+            if num_dims == 1:
+                self.sum_metric += (pred.flat == label_np.flat).sum()
+            elif num_dims == 2:
+                num_classes = pred.shape[1]
+                top_k = min(num_classes, self.top_k)
+                for j in range(top_k):
+                    self.sum_metric += (
+                        pred[:, num_classes - 1 - j].flat == label_np.flat).sum()
+            self.num_inst += num_samples
+
+
+class F1(EvalMetric):
+    """Binary F1 score (reference metric.py:183)."""
+
+    def __init__(self):
+        super().__init__("f1")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred_np = pred.asnumpy()
+            label_np = label.asnumpy().astype("int32")
+            pred_label = numpy.argmax(pred_np, axis=1)
+            check_label_shapes(label_np, pred_label)
+            if len(numpy.unique(label_np)) > 2:
+                raise ValueError("F1 currently only supports binary classification.")
+            true_positives, false_positives, false_negatives = 0.0, 0.0, 0.0
+            for y_pred, y_true in zip(pred_label, label_np):
+                if y_pred == 1 and y_true == 1:
+                    true_positives += 1.0
+                elif y_pred == 1 and y_true == 0:
+                    false_positives += 1.0
+                elif y_pred == 0 and y_true == 1:
+                    false_negatives += 1.0
+            if true_positives + false_positives > 0:
+                precision = true_positives / (true_positives + false_positives)
+            else:
+                precision = 0.0
+            if true_positives + false_negatives > 0:
+                recall = true_positives / (true_positives + false_negatives)
+            else:
+                recall = 0.0
+            if precision + recall > 0:
+                f1_score = 2 * precision * recall / (precision + recall)
+            else:
+                f1_score = 0.0
+            self.sum_metric += f1_score
+            self.num_inst += 1
+
+
+class Perplexity(EvalMetric):
+    """Perplexity = exp(mean NLL) (reference metric.py:230)."""
+
+    def __init__(self, ignore_label, axis=-1):
+        super().__init__("Perplexity")
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        assert len(labels) == len(preds)
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            label_np = label.asnumpy().astype("int32").reshape(-1)
+            pred_np = pred.asnumpy()
+            pred_np = pred_np.reshape(-1, pred_np.shape[-1] if self.axis in (-1, pred_np.ndim - 1)
+                                      else pred_np.shape[self.axis])
+            assert label_np.shape[0] == pred_np.shape[0], \
+                "shape mismatch: %s vs %s" % (label.shape, pred.shape)
+            probs = pred_np[numpy.arange(label_np.shape[0]), label_np]
+            if self.ignore_label is not None:
+                ignore = (label_np == self.ignore_label).astype(probs.dtype)
+                probs = probs * (1 - ignore) + ignore
+                num -= int(ignore.sum())
+            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
+            num += label_np.shape[0]
+        self.sum_metric += math.exp(loss / max(1, num)) * num
+        self.num_inst += num
+
+
+class MAE(EvalMetric):
+    """Mean absolute error (reference metric.py:280)."""
+
+    def __init__(self):
+        super().__init__("mae")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label_np = label.asnumpy()
+            pred_np = pred.asnumpy()
+            if len(label_np.shape) == 1:
+                label_np = label_np.reshape(label_np.shape[0], 1)
+            self.sum_metric += numpy.abs(label_np - pred_np).mean()
+            self.num_inst += 1
+
+
+class MSE(EvalMetric):
+    """Mean squared error (reference metric.py:294)."""
+
+    def __init__(self):
+        super().__init__("mse")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label_np = label.asnumpy()
+            pred_np = pred.asnumpy()
+            if len(label_np.shape) == 1:
+                label_np = label_np.reshape(label_np.shape[0], 1)
+            self.sum_metric += ((label_np - pred_np) ** 2.0).mean()
+            self.num_inst += 1
+
+
+class RMSE(EvalMetric):
+    """Root mean squared error (reference metric.py:308)."""
+
+    def __init__(self):
+        super().__init__("rmse")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label_np = label.asnumpy()
+            pred_np = pred.asnumpy()
+            if len(label_np.shape) == 1:
+                label_np = label_np.reshape(label_np.shape[0], 1)
+            self.sum_metric += numpy.sqrt(((label_np - pred_np) ** 2.0).mean())
+            self.num_inst += 1
+
+
+class CrossEntropy(EvalMetric):
+    """Cross-entropy of softmax outputs vs integer labels (reference
+    metric.py:335)."""
+
+    def __init__(self, eps=1e-8):
+        super().__init__("cross-entropy")
+        self.eps = eps
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label_np = label.asnumpy()
+            pred_np = pred.asnumpy()
+            label_np = label_np.ravel()
+            assert label_np.shape[0] == pred_np.shape[0]
+            prob = pred_np[numpy.arange(label_np.shape[0]), numpy.int64(label_np)]
+            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
+            self.num_inst += label_np.shape[0]
+
+
+class Loss(EvalMetric):
+    """Mean of the raw outputs — for MakeLoss-style networks whose output IS
+    the loss."""
+
+    def __init__(self, name="loss"):
+        super().__init__(name)
+
+    def update(self, _, preds):
+        for pred in preds:
+            self.sum_metric += float(pred.asnumpy().sum())
+            self.num_inst += pred.size
+
+
+class Torch(Loss):
+    """Parity alias for reference metric.Torch (mean of outputs)."""
+
+    def __init__(self, name="torch"):
+        super().__init__(name)
+
+
+class CustomMetric(EvalMetric):
+    """Wrap a feval(label, pred) -> float function (reference metric.py:370)."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for pred, label in zip(preds, labels):
+            label_np = label.asnumpy()
+            pred_np = pred.asnumpy()
+            reval = self._feval(label_np, pred_np)
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Create a CustomMetric from a numpy feval (reference metric.np)."""
+
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)
+
+
+def create(metric, **kwargs):
+    """Create a metric by name / callable / list (reference metric.create)."""
+    if callable(metric):
+        return CustomMetric(metric)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(child)
+        return composite
+    metrics = {
+        "acc": Accuracy, "accuracy": Accuracy, "ce": CrossEntropy,
+        "f1": F1, "mae": MAE, "mse": MSE, "rmse": RMSE,
+        "top_k_accuracy": TopKAccuracy, "topkaccuracy": TopKAccuracy,
+        "perplexity": Perplexity, "cross-entropy": CrossEntropy,
+        "torch": Torch, "loss": Loss, "composite": CompositeEvalMetric,
+    }
+    try:
+        return metrics[metric.lower()](**kwargs)
+    except Exception:
+        raise ValueError("Metric must be either callable or in {}".format(
+            sorted(metrics)))

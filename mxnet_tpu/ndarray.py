@@ -588,12 +588,19 @@ def _load_one(f) -> NDArray:
         return NDArray(np.zeros(()), cpu_ctx())
     dev_type, dev_id = struct.unpack("<ii", f.read(8))
     (type_flag,) = struct.unpack("<i", f.read(4))
-    if type_flag not in _FLAG_TYPE:
+    if type_flag == 7:
+        # legacy compat: earlier versions of THIS framework wrote bf16 arrays
+        # with invented flag 7 and a float32-widened payload; read them as
+        # float32.  (Upstream MXNet >=1.6 uses 7 for kBool, which the 0.9
+        # reference this targets never emits.)
+        dtype_name = "float32"
+    elif type_flag not in _FLAG_TYPE:
         # guessing an element size here would desynchronize the stream and
         # silently corrupt every subsequent array in the container
         raise MXNetError("unknown mshadow type flag %d in .params file"
                          % type_flag)
-    dtype_name = _FLAG_TYPE[type_flag]
+    else:
+        dtype_name = _FLAG_TYPE[type_flag]
     np_dtype = np.dtype(dtype_name)
     count = int(np.prod(shape))
     buf = f.read(count * np_dtype.itemsize)

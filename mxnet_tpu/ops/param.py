@@ -147,7 +147,9 @@ def attrs_to_strs(attrs: Dict[str, Any]) -> Dict[str, str]:
         if isinstance(value, bool):
             out[key] = "True" if value else "False"
         elif isinstance(value, tuple):
-            out[key] = "(" + ", ".join(str(int(v)) for v in value) + ")"
+            # preserve element types: float-shape params (sizes/ratios/...)
+            # must round-trip fractional values through JSON
+            out[key] = "(" + ", ".join(str(v) for v in value) + ")"
         else:
             out[key] = str(value)
     return out

@@ -1,0 +1,431 @@
+"""Optimizers — weight update rules.
+
+TPU-native counterpart of /root/reference/python/mxnet/optimizer.py:279-669.
+Same registry + class surface (SGD/DCASGD/NAG/SGLD/ccSGD/Adam/AdaGrad/
+RMSProp/AdaDelta/Test + Updater/get_updater); the update rules delegate to
+the fused update *ops* (ops/optimizer_ops.py — one XLA kernel per update,
+like the reference's fused CUDA kernels) where one exists, and to jnp
+expressions otherwise.  States are NDArrays so the kvstore updater path and
+Module.update share one implementation.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+from typing import Dict, Optional
+
+import numpy as np
+
+from .ndarray import NDArray, zeros
+from . import ndarray as nd
+
+__all__ = ["Optimizer", "SGD", "DCASGD", "NAG", "SGLD", "ccSGD", "Adam",
+           "AdaGrad", "RMSProp", "AdaDelta", "Test", "Updater", "get_updater",
+           "create", "register"]
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:10-270): owns lr/wd multipliers,
+    per-index update counts, gradient rescale/clip, and the state dict."""
+
+    opt_registry: Dict[str, type] = {}
+
+    @staticmethod
+    def register(klass):
+        assert isinstance(klass, type)
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            logging.warning("New optimizer %s is overriding existing one", name)
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict), \
+            "param_idx2name should be a dict of param indexes to names."
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    def create_state(self, index, weight):
+        """Create the state NDArray(s) for ``index`` (None if stateless)."""
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError("virtual Optimizer.update")
+
+    # -- multipliers -------------------------------------------------------
+    def set_lr_scale(self, args_lrscale):  # deprecated reference surface
+        raise DeprecationWarning("Use set_lr_mult instead.")
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+                elif name in attr and "lr_mult" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["lr_mult"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """No-wd default for biases/gammas/betas, like the reference
+        (optimizer.py set_wd_mult: params not ending in _weight/_gamma get
+        wd_mult 0)."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+                elif name in attr and "wd_mult" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["wd_mult"])
+        self.wd_mult.update(args_wd_mult)
+
+    # -- bookkeeping -------------------------------------------------------
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+# convenience wrapper for Optimizer.create_optimizer
+create = Optimizer.create_optimizer
+register = Optimizer.register
+
+
+def _clip(g, bound):
+    import jax.numpy as jnp
+
+    if bound is not None and bound > 0:
+        return jnp.clip(g, -bound, bound)
+    return g
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and weight decay (reference optimizer.py:279),
+    delegating to the fused sgd_update/sgd_mom_update ops."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray) and isinstance(grad, NDArray)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=self.clip_gradient
+                      if self.clip_gradient is not None else -1.0)
+        if state is not None:
+            nd.sgd_mom_update(weight, grad, state, out=weight,
+                              momentum=self.momentum, **kwargs)
+        else:
+            nd.sgd_update(weight, grad, out=weight, **kwargs)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py:325)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        g = _clip(g, self.clip_gradient)
+        mom, previous_weight = state
+        comp = g + wd * weight._data + self.lamda * g * g * (
+            weight._data - previous_weight._data)
+        if mom is not None:
+            new_mom = self.momentum * mom._data - lr * comp
+            mom._set(new_mom)
+            delta = new_mom
+        else:
+            delta = -lr * comp
+        previous_weight._set(weight._data)
+        weight._set(weight._data + delta)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference optimizer.py:380)."""
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        g = _clip(g, self.clip_gradient)
+        if state is not None:
+            mom = state._data * self.momentum
+            gw = g + wd * weight._data
+            mom = mom + gw
+            gw = gw + self.momentum * mom
+            state._set(mom)
+            weight._set(weight._data - lr * gw)
+        else:
+            assert self.momentum == 0.0
+            weight._set(weight._data - lr * (g + wd * weight._data))
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.py:416):
+    gradient step + N(0, sqrt(lr)) noise for posterior sampling."""
+
+    def update(self, index, weight, grad, state):
+        from . import random as _random
+        import jax
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        g = _clip(g, self.clip_gradient)
+        noise = jax.random.normal(_random.next_key(), weight.shape,
+                                  dtype=weight._data.dtype) * math.sqrt(lr)
+        weight._set(weight._data - lr / 2 * (g + wd * weight._data) + noise)
+
+
+@register
+class ccSGD(SGD):
+    """Same update as SGD; kept for API parity (reference's C++-side SGD,
+    optimizer.py:445)."""
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference optimizer.py:451) via the fused adam_update op, with
+    the reference's bias-correction folded into the effective lr."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),   # mean
+                zeros(weight.shape, weight.context, dtype=weight.dtype))   # var
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        nd.adam_update(weight, grad, mean, var, out=weight, lr=lr, wd=wd,
+                       beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon, rescale_grad=self.rescale_grad,
+                       clip_gradient=self.clip_gradient
+                       if self.clip_gradient is not None else -1.0)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference optimizer.py:499)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        g = _clip(g, self.clip_gradient)
+        history = state._data + jnp.square(g)
+        state._set(history)
+        weight._set(weight._data - lr * (
+            g / jnp.sqrt(history + self.float_stable_eps)
+            + wd * weight._data))
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp (reference optimizer.py:536): Tieleman's variant by default,
+    Graves' centered variant when ``centered=True``; delegates to the fused
+    rmsprop_update / rmspropalex_update ops."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # n
+                    zeros(weight.shape, weight.context, dtype=weight.dtype),  # g
+                    zeros(weight.shape, weight.context, dtype=weight.dtype))  # delta
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),)     # n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      gamma1=self.gamma1, epsilon=self.epsilon,
+                      clip_gradient=self.clip_gradient
+                      if self.clip_gradient is not None else -1.0,
+                      clip_weights=self.clip_weights
+                      if self.clip_weights is not None else -1.0)
+        if not self.centered:
+            (n,) = state
+            nd.rmsprop_update(weight, grad, n, out=weight, **kwargs)
+        else:
+            n, g, delta = state
+            nd.rmspropalex_update(weight, grad, n, g, delta, out=weight,
+                                  gamma2=self.gamma2, **kwargs)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference optimizer.py:605)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # E[g^2]
+                zeros(weight.shape, weight.context, dtype=weight.dtype))  # E[dx^2]
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        g = _clip(g, self.clip_gradient)
+        acc_g, acc_delta = state
+        new_acc_g = self.rho * acc_g._data + (1.0 - self.rho) * jnp.square(g)
+        delta = (jnp.sqrt(acc_delta._data + self.epsilon)
+                 / jnp.sqrt(new_acc_g + self.epsilon)) * g
+        new_acc_delta = self.rho * acc_delta._data + \
+            (1.0 - self.rho) * jnp.square(delta)
+        acc_g._set(new_acc_g)
+        acc_delta._set(new_acc_delta)
+        weight._set(weight._data - (delta + wd * weight._data))
+
+
+@register
+class Test(Optimizer):
+    """Trivial test optimizer: weight += grad * rescale (reference
+    optimizer.py:653)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight._set(weight._data + grad._data * self.rescale_grad)
+        state._set(weight._data)
+
+
+class Updater:
+    """Closure applying an optimizer on (index, grad, weight) — what runs on
+    the kvstore (reference optimizer.py:669 get_updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[int, object] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        self.states = pickle.loads(states)
+
+    def get_states(self):
+        return pickle.dumps(self.states)
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

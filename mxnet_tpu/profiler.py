@@ -1,0 +1,113 @@
+"""Profiler (parity: /root/reference/python/mxnet/profiler.py:10-38 over
+src/engine/profiler.{h,cc}).
+
+The reference stamps per-op micros and dumps Chrome trace JSON
+(profiler.h:88-109).  Here the heavy lifting is ``jax.profiler`` (XPlane →
+TensorBoard/perfetto, the richer superset of a chrome trace); this module
+keeps the reference's API shape and ALSO emits a minimal chrome-trace JSON
+of python-level step events so ``dump_profile`` output remains loadable in
+chrome://tracing.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import threading
+from typing import List, Optional
+
+from .base import env, register_env
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "pause", "resume", "Frame"]
+
+_state = {"mode": "symbolic", "filename": "profile.json", "running": False,
+          "events": [], "jax_trace_dir": None, "lock": threading.Lock()}
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Set profiler config (reference profiler.py:10): mode in
+    {'symbolic', 'all'}; filename receives the chrome trace on dump."""
+    if mode not in ("symbolic", "all"):
+        raise ValueError("profiler mode must be 'symbolic' or 'all'")
+    _state["mode"] = mode
+    _state["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """Start/stop profiling (reference profiler.py:22).  'run' also starts a
+    jax.profiler trace capturing device (TPU) activity."""
+    if state not in ("run", "stop"):
+        raise ValueError("profiler state must be 'run' or 'stop'")
+    import jax
+
+    if state == "run" and not _state["running"]:
+        _state["running"] = True
+        _state["events"] = []
+        trace_dir = os.path.splitext(_state["filename"])[0] + "_xplane"
+        try:
+            jax.profiler.start_trace(trace_dir)
+            _state["jax_trace_dir"] = trace_dir
+        except Exception:
+            _state["jax_trace_dir"] = None
+    elif state == "stop" and _state["running"]:
+        _state["running"] = False
+        if _state["jax_trace_dir"]:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+def pause():
+    _state["running"] = False
+
+
+def resume():
+    _state["running"] = True
+
+
+class Frame:
+    """Context manager recording one named span into the chrome trace (the
+    python-level analogue of OprExecStat, profiler.h:20-42)."""
+
+    def __init__(self, name, category="python"):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns() // 1000
+        return self
+
+    def __exit__(self, *exc):
+        if _state["running"]:
+            t1 = time.perf_counter_ns() // 1000
+            with _state["lock"]:
+                _state["events"].append({
+                    "name": self.name, "cat": self.category, "ph": "X",
+                    "ts": self._t0, "dur": t1 - self._t0, "pid": 0,
+                    "tid": threading.get_ident() % 100000})
+
+
+def record_event(name, t0_us, dur_us, category="op"):
+    if _state["running"]:
+        with _state["lock"]:
+            _state["events"].append({"name": name, "cat": category, "ph": "X",
+                                     "ts": t0_us, "dur": dur_us, "pid": 0,
+                                     "tid": 0})
+
+
+def dump_profile():
+    """Write the chrome trace file (reference profiler.py:34 → DumpProfile,
+    profiler.h:88)."""
+    with _state["lock"]:
+        payload = {"traceEvents": list(_state["events"]),
+                   "displayTimeUnit": "ms"}
+    with open(_state["filename"], "w") as f:
+        json.dump(payload, f)
+    return _state["filename"]
+
+
+register_env("MXNET_PROFILER_AUTOSTART", 0, int, "Start profiler at import.")
+if env("MXNET_PROFILER_AUTOSTART", 0, int):
+    profiler_set_state("run")

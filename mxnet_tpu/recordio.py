@@ -1,0 +1,203 @@
+"""RecordIO container format — readers/writers bit-compatible with the
+reference (dmlc-core recordio + python/mxnet/recordio.py).
+
+Format (dmlc/recordio.h semantics as used by im2rec and ImageRecordIter):
+each record = kMagic uint32 (0xced7230a) + lrecord uint32 (upper 3 bits =
+continue-flag, lower 29 = length) + payload + padding to 4-byte boundary.
+IRHeader packs (flag, label, id, id2) ahead of image payloads
+(python/mxnet/recordio.py IRHeader).
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_KMAGIC = 0xced7230a
+_LFLAG_BITS = 29
+_LENGTH_MASK = (1 << _LFLAG_BITS) - 1
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference recordio.py MXRecordIO,
+    backed by dmlc::RecordIOWriter/Reader)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.writable = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+
+    def close(self):
+        if self.handle is not None:
+            self.handle.close()
+            self.handle = None
+
+    def __del__(self):
+        self.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.handle.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self.handle.seek(pos)
+
+    def write(self, buf):
+        assert self.writable
+        if isinstance(buf, str):
+            buf = buf.encode("utf-8")
+        # one logical record, no multi-part continuation (parts only matter
+        # past 512MB payloads; reject instead of corrupting)
+        if len(buf) > _LENGTH_MASK:
+            raise ValueError("record too large for RecordIO format")
+        self.handle.write(struct.pack("<II", _KMAGIC, len(buf)))
+        self.handle.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        head = self.handle.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _KMAGIC:
+            raise IOError("invalid RecordIO magic %#x in %s" % (magic, self.uri))
+        length = lrec & _LENGTH_MASK
+        cflag = lrec >> _LFLAG_BITS
+        buf = self.handle.read(length)
+        if len(buf) < length:
+            raise IOError("truncated record in %s" % self.uri)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.handle.read(pad)
+        if cflag not in (0,):
+            # continuation records (written only for >512MB payloads)
+            raise IOError("multi-part RecordIO records are not supported")
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed RecordIO with a sidecar .idx of "key\\tposition" lines
+    (reference recordio.py MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.writable:
+            self.fidx = open(self.idx_path, "w")
+        else:
+            self.fidx = open(self.idx_path, "r")
+            for line in iter(self.fidx.readline, ""):
+                line = line.strip().split("\t")
+                key = self.key_type(line[0])
+                self.idx[key] = int(line[1])
+                self.keys.append(key)
+
+    def close(self):
+        super().close()
+        if self.fidx is not None:
+            self.fidx.close()
+            self.fidx = None
+
+    def seek(self, idx):
+        assert not self.writable
+        pos = self.idx[idx]
+        self.handle.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack an IRHeader + payload into one record string (reference
+    recordio.py pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                    header.id2) + s
+    return s
+
+
+def unpack(s):
+    """Unpack a record into (IRHeader, payload) (reference recordio.py
+    unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (HWC uint8) into a record (reference recordio.py
+    pack_img; PIL replaces OpenCV)."""
+    from .image_backend import encode_image
+
+    buf = encode_image(np.asarray(img, dtype=np.uint8), img_fmt, quality)
+    return pack(header, buf)
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a record into (IRHeader, image array) (reference recordio.py
+    unpack_img)."""
+    from .image_backend import decode_image
+
+    header, s = unpack(s)
+    channels = 3 if iscolor != 0 else 1
+    img = decode_image(s, channels)
+    return header, img
