@@ -102,7 +102,9 @@ class KVStore:
             if self._updater is not None:
                 self._updater(k, merged, self._store[k])
             else:
-                self._store[k]._set(self._store[k]._data + merged._data)
+                # no updater: the store holds the merged sum of this push
+                # (reference KVStoreLocal::Push CopyFromTo(merged, &local))
+                self._store[k]._set(merged._data)
 
     def pull(self, key, out=None, priority=0):
         keys, single = _key_list(key)
@@ -112,8 +114,17 @@ class KVStore:
                 raise MXNetError("pull of uninitialized key %s" % str(k))
             src = self._store[k]
             for o in olist:
-                o._set(src._data.astype(o.dtype) if o.dtype != src.dtype
-                       else src._data)
+                data = src._data.astype(o.dtype) if o.dtype != src.dtype \
+                    else src._data
+                # keep the destination's placement: pulling into a
+                # mesh-replicated parameter must not collapse it onto the
+                # store's single device
+                if getattr(o._data, "sharding", None) is not None and \
+                        data.sharding != o._data.sharding:
+                    import jax
+
+                    data = jax.device_put(data, o._data.sharding)
+                o._set(data)
 
     # -- control plane -----------------------------------------------------
     def set_optimizer(self, optimizer):

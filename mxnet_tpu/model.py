@@ -180,6 +180,13 @@ class FeedForward:
 
     def score(self, X, y=None, eval_metric="acc"):
         data = self._as_iter(X, y)
+        if self._module is None:  # e.g. right after FeedForward.load
+            mod = self._get_module(data)
+            mod.bind(data_shapes=data.provide_data,
+                     label_shapes=data.provide_label, for_training=False)
+            mod.set_params(self.arg_params or {}, self.aux_params or {},
+                           allow_missing=False)
+            self._module = mod
         res = self._module.score(data, eval_metric)
         return res[0][1]
 

@@ -1,0 +1,12 @@
+"""Model zoo: symbol builders for the benchmark configs
+(reference: example/image-classification/symbols/*.py — capability parity,
+fresh TPU-oriented implementations; NCHW layout with bf16-friendly blocks)."""
+
+from .lenet import get_lenet
+from .mlp import get_mlp
+from .resnet import get_resnet
+from .alexnet import get_alexnet
+from .inception_bn import get_inception_bn
+
+__all__ = ["get_lenet", "get_mlp", "get_resnet", "get_alexnet",
+           "get_inception_bn"]

@@ -1,0 +1,13 @@
+"""Multi-layer perceptron (reference example/image-classification/symbols/mlp.py
+capability)."""
+
+from .. import symbol as sym
+
+
+def get_mlp(num_classes=10, hidden=(128, 64)):
+    net = sym.Variable("data")
+    for i, h in enumerate(hidden):
+        net = sym.FullyConnected(data=net, num_hidden=h, name="fc%d" % (i + 1))
+        net = sym.Activation(data=net, act_type="relu", name="relu%d" % (i + 1))
+    net = sym.FullyConnected(data=net, num_hidden=num_classes, name="fc_out")
+    return sym.SoftmaxOutput(data=net, name="softmax")

@@ -223,8 +223,19 @@ class DataParallelExecutorGroup:
         if data_shapes == self.data_shapes and \
                 label_shapes == self.label_shapes:
             return
+        # preserve trained parameter/aux memory across the rebind (the
+        # reference reshapes executors in place, executor_group.py:378)
+        old_exec = self.execs[0] if self.execs else None
         self.batch_size = None
         self.bind_exec(data_shapes, label_shapes, reshape=True)
+        if old_exec is not None:
+            new_exec = self.execs[0]
+            for name in self.param_names:
+                if name in old_exec.arg_dict:
+                    new_exec.arg_dict[name]._set(old_exec.arg_dict[name]._data)
+            for name in self.aux_names:
+                if name in old_exec.aux_dict:
+                    new_exec.aux_dict[name]._set(old_exec.aux_dict[name]._data)
 
     # ------------------------------------------------------------------
     def set_params(self, arg_params, aux_params):

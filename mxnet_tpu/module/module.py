@@ -283,14 +283,11 @@ class Module(BaseModule):
         rescale_grad = 1.0 / batch_size
 
         if isinstance(optimizer, str):
-            idx2name = {}
-            if update_on_kvstore:
-                idx2name.update(enumerate(self._exec_group.param_names))
-            else:
-                for k in range(len(self._context)):
-                    idx2name.update(
-                        {i * len(self._context) + k: n for i, n
-                         in enumerate(self._exec_group.param_names)})
+            # one mesh executor regardless of len(context): updater indices
+            # are plain param positions (the reference's per-device
+            # i*ndev+k scheme only applies to its one-executor-per-device
+            # layout, executor_group.py:77)
+            idx2name = dict(enumerate(self._exec_group.param_names))
             optimizer_params = dict(optimizer_params)
             if "rescale_grad" not in optimizer_params:
                 optimizer_params["rescale_grad"] = rescale_grad

@@ -110,9 +110,8 @@ class PythonLossModule(PythonModule):
     def __init__(self, name="pyloss", data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  grad_func=None):
-        super().__init__([name + "_" + x for x in data_names] if False
-                         else list(data_names),
-                         list(label_names), [name + "_output"], logger=logger)
+        super().__init__(list(data_names), list(label_names),
+                         [name + "_output"], logger=logger)
         self._name = name
         assert len(data_names) == 1
         self._scores = None

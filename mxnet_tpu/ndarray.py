@@ -53,7 +53,10 @@ def _as_jax(x, ctx=None, dtype=None):
     elif isinstance(x, (int, float, np.generic)):
         data = jnp.asarray(x, dtype or mx_real_t)
     else:
-        data = jnp.asarray(x)
+        # Python lists/tuples default to float32 like the reference's
+        # nd.array (python/mxnet/ndarray.py array(): dtype=float32 unless
+        # the source carries its own dtype).
+        data = jnp.asarray(x, dtype or mx_real_t)
     if dtype is not None:
         dt = _np_dtype(dtype) if isinstance(dtype, str) else dtype
         if data.dtype != dt:
@@ -432,12 +435,15 @@ def array(source_array, ctx=None, dtype=None) -> NDArray:
     import jax
     import jax.numpy as jnp
 
+    carries_dtype = isinstance(source_array, (NDArray, np.ndarray, np.generic))
     if isinstance(source_array, NDArray):
         arr = source_array._data
     else:
         arr = np.asarray(source_array)
     if dtype is None:
-        if arr.dtype == np.float64:
+        if not carries_dtype:
+            dtype = mx_real_t  # python lists default to float32 (reference array())
+        elif arr.dtype == np.float64:
             dtype = mx_real_t  # reference defaults to float32
         elif arr.dtype == np.int64:
             dtype = np.int32

@@ -329,11 +329,14 @@ def _pooling(opctx, attrs, x):
     window = (1, 1) + kernel
     strides = (1, 1) + stride
     padding = [(0, 0), (0, 0)] + pads
+    # init values must be Python/numpy scalar literals: under jit a traced
+    # jnp.array init stops lax from recognizing the max/add monoid and routes
+    # to the generic (non-differentiable) reduce_window primitive
     if ptype == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.array(init, x.dtype), lax.max, window,
-                                 strides, padding)
-    summed = lax.reduce_window(x, jnp.array(0, x.dtype), lax.add, window,
+        init = (np.array(-np.inf, x.dtype) if jnp.issubdtype(x.dtype, jnp.floating)
+                else np.array(np.iinfo(x.dtype).min, x.dtype))
+        return lax.reduce_window(x, init, lax.max, window, strides, padding)
+    summed = lax.reduce_window(x, np.array(0, x.dtype), lax.add, window,
                                strides, padding)
     if ptype == "sum":
         return summed

@@ -17,8 +17,21 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from .ndarray import NDArray, zeros
+from .ndarray import NDArray
 from . import ndarray as nd
+
+
+def zeros(shape, ctx=None, dtype=None, like=None):
+    """Zeros for optimizer state.  When ``like`` (the weight) is given the
+    state inherits its sharding, so momentum/variance buffers live on the
+    same mesh as replicated parameters instead of a single device."""
+    if like is not None:
+        import jax.numpy as jnp
+
+        return NDArray(jnp.zeros_like(like._data), like.context)
+    from .ndarray import zeros as _nd_zeros
+
+    return _nd_zeros(shape, ctx, dtype=dtype)
 
 __all__ = ["Optimizer", "SGD", "DCASGD", "NAG", "SGLD", "ccSGD", "Adam",
            "AdaGrad", "RMSProp", "AdaDelta", "Test", "Updater", "get_updater",
@@ -161,7 +174,7 @@ class SGD(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return zeros(None, like=weight)
 
     def update(self, index, weight, grad, state):
         assert isinstance(weight, NDArray) and isinstance(grad, NDArray)
@@ -191,7 +204,7 @@ class DCASGD(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return (None, weight.copy())
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+        return (zeros(None, like=weight),
                 weight.copy())
 
     def update(self, index, weight, grad, state):
@@ -277,8 +290,8 @@ class Adam(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),   # mean
-                zeros(weight.shape, weight.context, dtype=weight.dtype))   # var
+        return (zeros(None, like=weight),   # mean
+                zeros(None, like=weight))   # var
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -305,7 +318,7 @@ class AdaGrad(Optimizer):
         self.float_stable_eps = eps
 
     def create_state(self, index, weight):
-        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return zeros(None, like=weight)
 
     def update(self, index, weight, grad, state):
         import jax.numpy as jnp
@@ -339,10 +352,10 @@ class RMSProp(Optimizer):
 
     def create_state(self, index, weight):
         if self.centered:
-            return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # n
-                    zeros(weight.shape, weight.context, dtype=weight.dtype),  # g
-                    zeros(weight.shape, weight.context, dtype=weight.dtype))  # delta
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),)     # n
+            return (zeros(None, like=weight),  # n
+                    zeros(None, like=weight),  # g
+                    zeros(None, like=weight))  # delta
+        return (zeros(None, like=weight),)     # n
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -373,8 +386,8 @@ class AdaDelta(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # E[g^2]
-                zeros(weight.shape, weight.context, dtype=weight.dtype))  # E[dx^2]
+        return (zeros(None, like=weight),  # E[g^2]
+                zeros(None, like=weight))  # E[dx^2]
 
     def update(self, index, weight, grad, state):
         import jax.numpy as jnp
@@ -400,7 +413,7 @@ class Test(Optimizer):
     optimizer.py:653)."""
 
     def create_state(self, index, weight):
-        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return zeros(None, like=weight)
 
     def update(self, index, weight, grad, state):
         weight._set(weight._data + grad._data * self.rescale_grad)

@@ -22,6 +22,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import builtins as _builtins
+
 from .attribute import AttrScope
 from .base import MXNetError
 from .name import NameManager
@@ -131,7 +133,7 @@ class Symbol:
             if index not in names:
                 raise ValueError("Cannot find output %s" % index)
             index = names.index(index)
-        if isinstance(index, slice):
+        if isinstance(index, _builtins.slice):
             return Symbol(self._outputs[index])
         return Symbol([self._outputs[index]])
 
@@ -441,7 +443,7 @@ def _forward_infer(sym: Symbol, known: Dict[str, Tuple], types_only=False):
     # of parameter-shape deduction need more than a fixed handful of sweeps.
     changed = True
     passes = 0
-    max_passes = max(10, 2 * len(nodes))
+    max_passes = _builtins.max(10, 2 * len(nodes))
     while changed and passes < max_passes:
         changed = False
         passes += 1

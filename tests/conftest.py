@@ -1,0 +1,25 @@
+"""Test config: run on a virtual 8-device CPU mesh so sharding/DP paths are
+exercised without TPU hardware (reference analogue: test_multi_device_exec.py
+faking group2ctx with multiple mx.cpu(i) contexts)."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# the harness environment presets the axon TPU platform (and something in the
+# image pins jax_platforms to "axon,cpu" ignoring the env var); tests run on
+# the virtual 8-device CPU platform, so force the config before backend init
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)

@@ -1,0 +1,131 @@
+"""Executor bind/forward/backward semantics: grad_req, aux updates, reshape,
+monitor (reference: tests/python/unittest/test_executor.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_bind_forward_backward():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    s = a * b
+    a_np = np.random.randn(3, 3).astype(np.float32)
+    b_np = np.random.randn(3, 3).astype(np.float32)
+    exe = s.bind(mx.cpu(), {"a": nd.array(a_np), "b": nd.array(b_np)},
+                 args_grad={"a": nd.zeros((3, 3)), "b": nd.zeros((3, 3))})
+    out = exe.forward(is_train=True)[0]
+    assert_almost_equal(out, a_np * b_np)
+    exe.backward([nd.ones((3, 3))])
+    assert_almost_equal(exe.grad_dict["a"], b_np)
+    assert_almost_equal(exe.grad_dict["b"], a_np)
+
+
+def test_grad_req_null():
+    a, b = sym.Variable("a"), sym.Variable("b")
+    s = a * b
+    exe = s.bind(mx.cpu(), {"a": nd.ones((2,)), "b": nd.ones((2,))},
+                 args_grad={"a": nd.zeros((2,))},
+                 grad_req={"a": "write", "b": "null"})
+    exe.forward(is_train=True)
+    exe.backward([nd.ones((2,))])
+    assert_almost_equal(exe.grad_dict["a"], np.ones(2, np.float32))
+    assert exe.grad_dict.get("b") is None
+
+
+def test_grad_req_add_accumulates():
+    a = sym.Variable("a")
+    s = a * 3.0
+    exe = s.bind(mx.cpu(), {"a": nd.ones((2,))},
+                 args_grad={"a": nd.zeros((2,))}, grad_req="add")
+    for i in range(3):
+        exe.forward(is_train=True)
+        exe.backward([nd.ones((2,))])
+    assert_almost_equal(exe.grad_dict["a"], np.full(2, 9.0, np.float32))
+
+
+def test_grad_req_write_overwrites():
+    a = sym.Variable("a")
+    s = a * 3.0
+    exe = s.bind(mx.cpu(), {"a": nd.ones((2,))},
+                 args_grad={"a": nd.zeros((2,))}, grad_req="write")
+    for _ in range(3):
+        exe.forward(is_train=True)
+        exe.backward([nd.ones((2,))])
+    assert_almost_equal(exe.grad_dict["a"], np.full(2, 3.0, np.float32))
+
+
+def test_simple_bind():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    exe = net.simple_bind(mx.cpu(), data=(2, 6))
+    assert exe.arg_dict["fc_weight"].shape == (4, 6)
+    exe.arg_dict["data"][:] = 1.0
+    out = exe.forward()[0]
+    assert out.shape == (2, 4)
+
+
+def test_aux_state_update_only_in_train():
+    data = sym.Variable("data")
+    s = sym.BatchNorm(data=data, momentum=0.5, name="bn")
+    x = np.random.randn(8, 3).astype(np.float32) * 2 + 1
+    exe = s.bind(mx.cpu(), {"data": nd.array(x), "bn_gamma": nd.ones((3,)),
+                            "bn_beta": nd.zeros((3,))},
+                 aux_states={"bn_moving_mean": nd.zeros((3,)),
+                             "bn_moving_var": nd.ones((3,))})
+    exe.forward(is_train=False)
+    assert_almost_equal(exe.aux_dict["bn_moving_mean"], np.zeros(3))
+    exe.forward(is_train=True)
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    assert np.abs(mm).sum() > 0  # updated by momentum rule
+
+
+def test_outputs_dict_and_multiple_outputs():
+    a = sym.Variable("a")
+    g = sym.Group([a + 1.0, a * 2.0])
+    exe = g.bind(mx.cpu(), {"a": nd.array([1.0, 2.0])})
+    outs = exe.forward()
+    assert len(outs) == 2
+    assert_almost_equal(outs[0], [2.0, 3.0])
+    assert_almost_equal(outs[1], [2.0, 4.0])
+
+
+def test_monitor_callback():
+    seen = []
+    a = sym.Variable("a")
+    s = sym.Activation(data=a * 2.0, act_type="relu", name="act")
+    exe = s.bind(mx.cpu(), {"a": nd.array([1.0, -1.0])})
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    exe.forward(is_train=True)
+    assert len(seen) > 0
+
+
+def test_reshape():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    exe = net.simple_bind(mx.cpu(), data=(2, 6))
+    exe2 = exe.reshape(data=(5, 6))
+    exe2.arg_dict["data"][:] = 1.0
+    assert exe2.forward()[0].shape == (5, 4)
+    # weights shared with original executor
+    assert exe2.arg_dict["fc_weight"] is exe.arg_dict["fc_weight"]
+
+
+def test_copy_params_from():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=2, name="fc")
+    exe = net.simple_bind(mx.cpu(), data=(1, 3))
+    w = np.random.randn(2, 3).astype(np.float32)
+    exe.copy_params_from({"fc_weight": nd.array(w), "fc_bias": nd.zeros((2,))})
+    assert_almost_equal(exe.arg_dict["fc_weight"], w)
+
+
+def test_head_gradient_scaling():
+    a = sym.Variable("a")
+    s = a * 1.0
+    exe = s.bind(mx.cpu(), {"a": nd.ones((3,))},
+                 args_grad={"a": nd.zeros((3,))})
+    exe.forward(is_train=True)
+    exe.backward([nd.array([1.0, 2.0, 3.0])])
+    assert_almost_equal(exe.grad_dict["a"], [1.0, 2.0, 3.0])

@@ -1,0 +1,166 @@
+"""Data iterator + RecordIO tests
+(reference: tests/python/unittest/test_io.py + test_recordio.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ndarray_iter_basic():
+    X = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    assert_almost_equal(batches[0].data[0], X[:5])
+    assert_almost_equal(batches[0].label[0], y[:5])
+
+
+def test_ndarray_iter_pad():
+    X = np.arange(28, dtype=np.float32).reshape(7, 4)
+    it = mx.io.NDArrayIter(X, np.zeros(7, np.float32), batch_size=5,
+                           last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].pad == 3
+
+
+def test_ndarray_iter_discard():
+    X = np.arange(28, dtype=np.float32).reshape(7, 4)
+    it = mx.io.NDArrayIter(X, np.zeros(7, np.float32), batch_size=5,
+                           last_batch_handle="discard")
+    assert len(list(it)) == 1
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    X = np.arange(20, dtype=np.float32).reshape(20, 1)
+    it = mx.io.NDArrayIter(X, np.arange(20, dtype=np.float32), batch_size=4,
+                           shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen.tolist()) == list(range(20))
+
+
+def test_ndarray_iter_reset():
+    X = np.arange(8, dtype=np.float32).reshape(4, 2)
+    it = mx.io.NDArrayIter(X, np.zeros(4, np.float32), batch_size=2)
+    n1 = len(list(it))
+    it.reset()
+    n2 = len(list(it))
+    assert n1 == n2 == 2
+
+
+def test_ndarray_iter_dict_data():
+    it = mx.io.NDArrayIter({"a": np.zeros((6, 2), np.float32),
+                            "b": np.ones((6, 3), np.float32)},
+                           np.zeros(6, np.float32), batch_size=3)
+    assert sorted(d.name for d in it.provide_data) == ["a", "b"]
+    b0 = next(iter(it))
+    assert len(b0.data) == 2
+
+
+def test_resize_iter():
+    X = np.zeros((12, 2), np.float32)
+    base = mx.io.NDArrayIter(X, np.zeros(12, np.float32), batch_size=3)
+    it = mx.io.ResizeIter(base, 2)
+    assert len(list(it)) == 2
+
+
+def test_prefetching_iter():
+    X = np.arange(24, dtype=np.float32).reshape(12, 2)
+    base = mx.io.NDArrayIter(X, np.zeros(12, np.float32), batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 3
+    assert_almost_equal(batches[0].data[0], X[:4])
+
+
+def test_csv_iter():
+    with tempfile.TemporaryDirectory() as d:
+        data_path = os.path.join(d, "data.csv")
+        X = np.random.randn(10, 3).astype(np.float32)
+        np.savetxt(data_path, X, delimiter=",")
+        it = mx.io.CSVIter(data_csv=data_path, data_shape=(3,), batch_size=5)
+        batches = list(it)
+        assert len(batches) == 2
+        assert_almost_equal(batches[0].data[0], X[:5], rtol=1e-4, atol=1e-5)
+
+
+def test_recordio_roundtrip():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "test.rec")
+        w = recordio.MXRecordIO(path, "w")
+        records = [b"hello", b"world" * 100, b""]
+        for r in records:
+            w.write(r)
+        w.close()
+        r = recordio.MXRecordIO(path, "r")
+        out = []
+        while True:
+            item = r.read()
+            if item is None:
+                break
+            out.append(item)
+        r.close()
+    assert out == records
+
+
+def test_indexed_recordio():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "test.rec")
+        idx_path = os.path.join(d, "test.idx")
+        w = recordio.MXIndexedRecordIO(idx_path, path, "w")
+        for i in range(5):
+            w.write_idx(i, b"rec%d" % i)
+        w.close()
+        r = recordio.MXIndexedRecordIO(idx_path, path, "r")
+        assert r.read_idx(3) == b"rec3"
+        assert r.read_idx(0) == b"rec0"
+        r.close()
+
+
+def test_irheader_pack_unpack():
+    header = recordio.IRHeader(0, 2.0, 7, 0)
+    data = b"imagebytes"
+    packed = recordio.pack(header, data)
+    h2, d2 = recordio.unpack(packed)
+    assert h2.label == 2.0
+    assert h2.id == 7
+    assert d2 == data
+
+
+def test_irheader_multi_label():
+    header = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0], np.float32), 1, 0)
+    packed = recordio.pack(header, b"x")
+    h2, d2 = recordio.unpack(packed)
+    np.testing.assert_allclose(h2.label, [1.0, 2.0, 3.0])
+
+
+def test_mnist_iter_synthetic():
+    # MNISTIter reads idx-format files; synthesize a tiny one
+    with tempfile.TemporaryDirectory() as d:
+        img_path = os.path.join(d, "images-idx3-ubyte")
+        lbl_path = os.path.join(d, "labels-idx1-ubyte")
+        n = 20
+        images = (np.random.rand(n, 28, 28) * 255).astype(np.uint8)
+        labels = np.random.randint(0, 10, n).astype(np.uint8)
+        import struct
+        with open(img_path, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(images.tobytes())
+        with open(lbl_path, "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(labels.tobytes())
+        it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=5,
+                             shuffle=False)
+        batches = list(it)
+        assert len(batches) == 4
+        b0 = batches[0]
+        assert b0.data[0].shape[0] == 5
+        np.testing.assert_allclose(b0.label[0].asnumpy(), labels[:5])

@@ -1,0 +1,121 @@
+"""KVStore local semantics vs numpy (reference:
+tests/python/unittest/test_kvstore.py:21-40 and tests/nightly/test_kvstore.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 4)
+KEYS = [3, 5, 7]
+
+
+def _init_kv(kv_type="local"):
+    kv = mx.kv.create(kv_type)
+    kv.init(KEYS, [nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def test_single_kv_pair():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.ones(SHAPE))
+
+
+def test_push_aggregates():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.zeros(SHAPE))
+    # push a list of 4 devices' grads for one key -> summed
+    kv.push(3, [nd.ones(SHAPE)] * 4)
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.ones(SHAPE) * 4)
+
+
+def test_push_replaces_store_without_updater():
+    # reference KVStoreLocal::Push: without an updater the store holds the
+    # merged value of the LAST push, not a running accumulation
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones(SHAPE) * 2)
+    kv.push(3, nd.ones(SHAPE) * 8)
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.full(SHAPE, 8.0))
+    kv.push(3, nd.ones(SHAPE) * 5)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.full(SHAPE, 5.0))
+
+
+def test_list_kv_pairs():
+    kv = _init_kv()
+    kv.push(KEYS, [[nd.ones(SHAPE) * 2.0]] * len(KEYS))
+    outs = [nd.zeros(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        assert_almost_equal(o, np.full(SHAPE, 2.0))
+
+
+def test_updater_runs_on_push():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones(SHAPE) * 4)
+
+    def updater(key, recv, stored):
+        stored += recv * 2.0
+
+    kv._set_updater(updater)
+    kv.push(3, [nd.ones(SHAPE)] * 3)  # merged = 3, stored += 6
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.full(SHAPE, 10.0))
+
+
+def test_set_optimizer_applies_update():
+    kv = mx.kv.create("local")
+    kv.init(0, nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0,
+                                      wd=0.0))
+    kv.push(0, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(0, out=out)
+    assert_almost_equal(out, np.full(SHAPE, 0.9), rtol=1e-5, atol=1e-6)
+
+
+def test_device_type_same_semantics():
+    kv = mx.kv.create("device")
+    kv.init(3, nd.zeros(SHAPE))
+    kv.push(3, [nd.ones(SHAPE)] * 2)
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.full(SHAPE, 2.0))
+    assert kv.type == "device"
+
+
+def test_rank_and_num_workers_local():
+    kv = mx.kv.create("local")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+
+
+def test_string_keys():
+    kv = mx.kv.create("local")
+    kv.init("weight", nd.ones((2, 2)))
+    out = nd.zeros((2, 2))
+    kv.pull("weight", out=out)
+    assert_almost_equal(out, np.ones((2, 2)))
+
+
+def test_duplicate_init_raises():
+    kv = mx.kv.create("local")
+    kv.init(1, nd.zeros((2,)))
+    with pytest.raises(mx.MXNetError):
+        kv.init(1, nd.zeros((2,)))
+
+
+def test_push_before_init_raises():
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.push(9, nd.ones((2,)))

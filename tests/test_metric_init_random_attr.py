@@ -1,0 +1,189 @@
+"""Metrics, initializers, RNG, attribute scopes
+(reference: test_metric via usage, test_init.py, test_random.py, test_attr.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# -- metrics ---------------------------------------------------------------
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    preds = nd.array(np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]],
+                              np.float32))
+    labels = nd.array(np.array([0.0, 1.0, 1.0], np.float32))
+    m.update([labels], [preds])
+    name, val = m.get()
+    assert name == "accuracy"
+    assert abs(val - 2.0 / 3.0) < 1e-6
+
+
+def test_topk_accuracy():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    preds = nd.array(np.array([[0.1, 0.2, 0.7], [0.8, 0.15, 0.05]],
+                              np.float32))
+    labels = nd.array(np.array([1.0, 2.0], np.float32))
+    m.update([labels], [preds])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_mse_mae_rmse():
+    preds = nd.array(np.array([[1.0], [2.0]], np.float32))
+    labels = nd.array(np.array([[0.0], [4.0]], np.float32))
+    m = mx.metric.MSE()
+    m.update([labels], [preds])
+    assert abs(m.get()[1] - (1.0 + 4.0) / 2.0) < 1e-6
+    m = mx.metric.MAE()
+    m.update([labels], [preds])
+    assert abs(m.get()[1] - 1.5) < 1e-6
+    m = mx.metric.RMSE()
+    m.update([labels], [preds])
+    assert abs(m.get()[1] - np.sqrt(2.5)) < 1e-5
+
+
+def test_cross_entropy_and_perplexity():
+    preds = nd.array(np.array([[0.5, 0.5], [0.1, 0.9]], np.float32))
+    labels = nd.array(np.array([0.0, 1.0], np.float32))
+    m = mx.metric.CrossEntropy()
+    m.update([labels], [preds])
+    expected = -(np.log(0.5) + np.log(0.9)) / 2
+    assert abs(m.get()[1] - expected) < 1e-5
+    p = mx.metric.Perplexity(ignore_label=None)
+    p.update([labels], [preds])
+    assert abs(p.get()[1] - np.exp(expected)) < 1e-4
+
+
+def test_f1():
+    m = mx.metric.F1()
+    preds = nd.array(np.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7]],
+                              np.float32))
+    labels = nd.array(np.array([1.0, 0.0, 0.0], np.float32))
+    m.update([labels], [preds])
+    # tp=1 fp=1 fn=0 -> precision=0.5 recall=1 -> f1=2/3
+    assert abs(m.get()[1] - 2.0 / 3.0) < 1e-6
+
+
+def test_composite_metric():
+    m = mx.metric.CompositeEvalMetric()
+    m.add(mx.metric.Accuracy())
+    m.add(mx.metric.CrossEntropy())
+    preds = nd.array(np.array([[0.9, 0.1]], np.float32))
+    labels = nd.array(np.array([0.0], np.float32))
+    m.update([labels], [preds])
+    names, vals = m.get()
+    assert len(names) == 2
+
+
+def test_custom_metric():
+    m = mx.metric.CustomMetric(lambda l, p: np.abs(l - p).mean(), name="mad")
+    m.update([nd.array([1.0])], [nd.array([3.0])])
+    assert abs(m.get()[1] - 2.0) < 1e-6
+
+
+def test_metric_create_by_name():
+    assert mx.metric.create("acc").name == "accuracy"
+    assert mx.metric.create("mse").name == "mse"
+    comp = mx.metric.create(["acc", "mse"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+
+
+# -- initializers ----------------------------------------------------------
+
+def _init_array(init, name="weight", shape=(50, 40)):
+    arr = nd.zeros(shape)
+    desc = mx.init.InitDesc(name)
+    init(desc, arr)
+    return arr.asnumpy()
+
+
+def test_uniform_normal_constant():
+    a = _init_array(mx.init.Uniform(0.5))
+    assert a.min() >= -0.5 and a.max() <= 0.5 and np.abs(a).sum() > 0
+    a = _init_array(mx.init.Normal(2.0))
+    assert abs(a.std() - 2.0) < 0.3
+    a = _init_array(mx.init.Constant(3.0) if hasattr(mx.init, "Constant")
+                    else mx.init.One())
+    assert np.all(a != 0)
+
+
+def test_xavier_magnitude():
+    shape = (100, 80)
+    a = _init_array(mx.init.Xavier(factor_type="avg", magnitude=3.0),
+                    shape=shape)
+    scale = np.sqrt(3.0 / ((shape[0] + shape[1]) / 2.0))
+    assert a.min() >= -scale - 1e-5 and a.max() <= scale + 1e-5
+    assert a.std() > scale / 4
+
+
+def test_bias_initialized_zero():
+    arr = nd.ones((10,))
+    mx.init.Xavier()(mx.init.InitDesc("fc1_bias"), arr)
+    assert_almost_equal(arr, np.zeros(10))
+
+
+def test_orthogonal():
+    a = _init_array(mx.init.Orthogonal(), shape=(20, 20))
+    # columns orthogonal: A @ A.T ~ scale^2 * I
+    prod = a @ a.T
+    off = prod - np.diag(np.diag(prod))
+    assert np.abs(off).max() < 1e-3
+
+
+def test_init_desc_attrs_lr_mult_passthrough():
+    # gamma inits to one, beta to zero
+    arr = nd.zeros((4,))
+    mx.init.Xavier()(mx.init.InitDesc("bn_gamma"), arr)
+    assert_almost_equal(arr, np.ones(4))
+
+
+# -- RNG -------------------------------------------------------------------
+
+def test_seed_reproducibility():
+    mx.random.seed(7)
+    a = nd.random_uniform(shape=(5,)) if hasattr(nd, "random_uniform") else \
+        nd.uniform(shape=(5,))
+    mx.random.seed(7)
+    b = nd.random_uniform(shape=(5,)) if hasattr(nd, "random_uniform") else \
+        nd.uniform(shape=(5,))
+    assert_almost_equal(a, b)
+
+
+def test_different_calls_different_draws():
+    mx.random.seed(7)
+    a = nd.uniform(shape=(100,))
+    b = nd.uniform(shape=(100,))
+    assert np.abs(a.asnumpy() - b.asnumpy()).sum() > 1e-3
+
+
+# -- attribute / name scopes ----------------------------------------------
+
+def test_attr_scope():
+    with mx.AttrScope(lr_mult="2.0"):
+        v = sym.Variable("w")
+    assert v.attr("lr_mult") == "2.0"
+
+
+def test_attr_scope_nesting():
+    with mx.AttrScope(group="a"):
+        with mx.AttrScope(mult="3"):
+            v = sym.Variable("x")
+    assert v.attr("group") == "a"
+    assert v.attr("mult") == "3"
+
+
+def test_name_manager_auto_naming():
+    data = sym.Variable("data")
+    s1 = sym.FullyConnected(data=data, num_hidden=2)
+    s2 = sym.FullyConnected(data=data, num_hidden=2)
+    assert s1.name != s2.name
+
+
+def test_prefix_name_manager():
+    with mx.name.Prefix("mynet_"):
+        data = sym.Variable("data")
+        s = sym.FullyConnected(data=data, num_hidden=2)
+    assert s.name.startswith("mynet_")

@@ -1,0 +1,204 @@
+"""Module/fit training API tests incl. convergence gate
+(reference: tests/python/unittest/test_module.py + tests/python/train/test_mlp.py;
+convergence thresholds follow tests/nightly/test_all.sh:54-60)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _toy_classification(n=400, d=10, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d, k).astype(np.float32)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    return X, y
+
+
+def _mlp(k=3):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=32, name="fc1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, num_hidden=k, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def test_module_bind_init_forward():
+    net = _mlp()
+    mod = mx.mod.Module(net, label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    batch = mx.io.DataBatch(data=[nd.zeros((8, 10))],
+                            label=[nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (8, 3)
+    # uniform softmax on zero input with zero bias
+    assert_almost_equal(out.asnumpy().sum(axis=1), np.ones(8), rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_module_fit_converges():
+    X, y = _toy_classification()
+    train = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp(), label_names=("softmax_label",))
+    mod.fit(train, num_epoch=15, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            eval_metric="acc",
+            initializer=mx.init.Xavier())
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=40),
+                      mx.metric.Accuracy())
+    acc = dict(score)["accuracy"]
+    assert acc >= 0.95, "MLP failed to fit toy data: acc=%f" % acc
+
+
+def test_module_fit_with_eval_data_and_callbacks():
+    X, y = _toy_classification()
+    train = mx.io.NDArrayIter(X[:300], y[:300], batch_size=30, shuffle=True)
+    val = mx.io.NDArrayIter(X[300:], y[300:], batch_size=30)
+    epochs_seen = []
+    mod = mx.mod.Module(_mlp(), label_names=("softmax_label",))
+    mod.fit(train, eval_data=val, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            epoch_end_callback=lambda e, s, a, x: epochs_seen.append(e),
+            batch_end_callback=mx.callback.Speedometer(30, frequent=5))
+    assert epochs_seen == [0, 1, 2]
+
+
+def test_module_predict():
+    X, y = _toy_classification(n=64)
+    mod = mx.mod.Module(_mlp(), label_names=("softmax_label",))
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape == (64, 3)
+
+
+def test_module_save_load_checkpoint():
+    X, y = _toy_classification(n=80)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp(), label_names=("softmax_label",))
+    mod.fit(train, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "model")
+        mod.save_checkpoint(prefix, 2)
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0002.params")
+        mod2 = mx.mod.Module.load(prefix, 2, label_names=("softmax_label",))
+        mod2.bind(data_shapes=train.provide_data,
+                  label_shapes=train.provide_label)
+        arg1, _ = mod.get_params()
+        arg2, _ = mod2.get_params()
+        for k in arg1:
+            assert_almost_equal(arg1[k], arg2[k])
+
+
+def test_module_get_set_params():
+    mod = mx.mod.Module(_mlp(), label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    args, aux = mod.get_params()
+    args["fc1_weight"] = nd.ones(args["fc1_weight"].shape)
+    mod.set_params(args, aux)
+    args2, _ = mod.get_params()
+    assert_almost_equal(args2["fc1_weight"],
+                        np.ones(args["fc1_weight"].shape, np.float32))
+
+
+def test_module_grad_array_access():
+    mod = mx.mod.Module(_mlp(), label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))], for_training=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[nd.array(np.random.randn(4, 10)
+                                           .astype(np.float32))],
+                            label=[nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    # gradient arrays on the exec group must be populated after backward
+    assert mod._exec_group is not None
+    assert any(g is not None for g in mod._exec_group.grad_arrays)
+
+
+def test_lenet_mnist_style_convergence():
+    """LeNet on a synthetic MNIST-like task (reference CI gate:
+    tests/nightly/test_all.sh:54-60 requires lenet val-acc >= 0.99; here the
+    task is synthetic since the image has no dataset egress)."""
+    rng = np.random.RandomState(42)
+    n, k = 256, 4
+    # well-separated blobs rendered into 1x16x16 images
+    X = np.zeros((n, 1, 16, 16), np.float32)
+    y = rng.randint(0, k, n).astype(np.float32)
+    for i in range(n):
+        c = int(y[i])
+        X[i, 0, 4 * (c // 2):4 * (c // 2) + 4,
+          4 * (c % 2):4 * (c % 2) + 4] = 1.0
+    X += rng.randn(*X.shape).astype(np.float32) * 0.1
+
+    data = sym.Variable("data")
+    net = sym.Convolution(data=data, num_filter=8, kernel=(3, 3), name="c1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.Pooling(data=net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = sym.Flatten(data=net)
+    net = sym.FullyConnected(data=net, num_hidden=k, name="fc")
+    net = sym.SoftmaxOutput(data=net, name="softmax")
+
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(net, label_names=("softmax_label",))
+    mod.fit(train, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=32),
+                      mx.metric.Accuracy())
+    acc = dict(score)["accuracy"]
+    assert acc >= 0.99, "LeNet-style conv net under 0.99 gate: %f" % acc
+
+
+def test_module_reshape_preserves_params():
+    mod = mx.mod.Module(_mlp(), label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Uniform(0.5))
+    before, _ = mod.get_params()
+    mod.reshape(data_shapes=[("data", (6, 10))],
+                label_shapes=[("softmax_label", (6,))])
+    after, _ = mod.get_params()
+    for k in before:
+        assert_almost_equal(before[k], after[k],
+                            names=("before[%s]" % k, "after[%s]" % k))
+    batch = mx.io.DataBatch(data=[nd.zeros((6, 10))],
+                            label=[nd.zeros((6,))])
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (6, 3)
+
+
+def test_feedforward_load_then_score():
+    X, y = _toy_classification(n=64)
+    ff = mx.model.FeedForward(_mlp(), num_epoch=2, optimizer="sgd",
+                              learning_rate=0.3)
+    ff.fit(mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True))
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "ffmodel")
+        ff.save(prefix)
+        loaded = mx.model.FeedForward.load(prefix, 2)
+        acc = loaded.score(mx.io.NDArrayIter(X, y, batch_size=16))
+    assert 0.0 <= acc <= 1.0
+
+
+def test_feedforward_api():
+    X, y = _toy_classification(n=80)
+    ff = mx.model.FeedForward(_mlp(), num_epoch=3, optimizer="sgd",
+                              learning_rate=0.3)
+    ff.fit(mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True))
+    preds = ff.predict(mx.io.NDArrayIter(X, y, batch_size=16))
+    assert preds.shape == (80, 3)

@@ -1,0 +1,121 @@
+"""Data-parallel execution over the 8-virtual-device CPU mesh
+(reference: tests/python/unittest/test_multi_device_exec.py +
+executor_group slicing semantics; here the mesh replaces per-device
+executors and XLA inserts the gradient reduction)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _devices():
+    import jax
+    return jax.devices()
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def test_eight_virtual_devices_present():
+    assert len(_devices()) >= 8, \
+        "conftest must force 8 virtual CPU devices"
+
+
+def test_dp_forward_matches_single_device():
+    n_dev = 8
+    ctxs = [mx.cpu(i) for i in range(n_dev)]
+    X = np.random.randn(16, 10).astype(np.float32)
+    y = np.zeros(16, np.float32)
+
+    mod1 = mx.mod.Module(_mlp(), label_names=("softmax_label",),
+                         context=mx.cpu(0))
+    mod1.bind(data_shapes=[("data", (16, 10))],
+              label_shapes=[("softmax_label", (16,))])
+    mod1.init_params(mx.init.Xavier(rnd_type="uniform", magnitude=2.0))
+
+    modN = mx.mod.Module(_mlp(), label_names=("softmax_label",), context=ctxs)
+    modN.bind(data_shapes=[("data", (16, 10))],
+              label_shapes=[("softmax_label", (16,))])
+    arg, aux = mod1.get_params()
+    modN.set_params(arg, aux)
+
+    batch = mx.io.DataBatch(data=[nd.array(X)], label=[nd.array(y)])
+    mod1.forward(batch, is_train=False)
+    modN.forward(batch, is_train=False)
+    assert_almost_equal(mod1.get_outputs()[0], modN.get_outputs()[0],
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_dp_gradients_match_single_device():
+    ctxs = [mx.cpu(i) for i in range(8)]
+    X = np.random.randn(16, 10).astype(np.float32)
+    y = (np.arange(16) % 4).astype(np.float32)
+
+    def run(mod):
+        mod.bind(data_shapes=[("data", (16, 10))],
+                 label_shapes=[("softmax_label", (16,))], for_training=True)
+        mod.init_params(mx.init.Uniform(0.1))
+        return mod
+
+    mod1 = run(mx.mod.Module(_mlp(), label_names=("softmax_label",),
+                             context=mx.cpu(0)))
+    modN = run(mx.mod.Module(_mlp(), label_names=("softmax_label",),
+                             context=ctxs))
+    arg, aux = mod1.get_params()
+    modN.set_params(arg, aux)
+
+    batch = mx.io.DataBatch(data=[nd.array(X)], label=[nd.array(y)])
+    for mod in (mod1, modN):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+    g1 = mod1._exec_group.execs[0].grad_dict
+    gN = modN._exec_group.execs[0].grad_dict
+    for name in g1:
+        assert_almost_equal(g1[name], gN[name], rtol=1e-4, atol=1e-5,
+                            names=("single[%s]" % name, "mesh[%s]" % name))
+
+
+def test_dp_batch_is_sharded_params_replicated():
+    ctxs = [mx.cpu(i) for i in range(8)]
+    mod = mx.mod.Module(_mlp(), label_names=("softmax_label",), context=ctxs)
+    mod.bind(data_shapes=[("data", (32, 10))],
+             label_shapes=[("softmax_label", (32,))])
+    mod.init_params()
+    exe = mod._exec_group.execs[0]
+    data_sh = exe.arg_dict["data"]._data.sharding
+    w_sh = exe.arg_dict["fc1_weight"]._data.sharding
+    assert not data_sh.is_fully_replicated
+    assert w_sh.is_fully_replicated
+
+
+def test_dp_fit_converges():
+    rng = np.random.RandomState(3)
+    X = rng.randn(256, 10).astype(np.float32)
+    w = rng.randn(10, 4).astype(np.float32)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    ctxs = [mx.cpu(i) for i in range(8)]
+    train = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True)
+    mod = mx.mod.Module(_mlp(), label_names=("softmax_label",), context=ctxs)
+    mod.fit(train, num_epoch=25, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            kvstore="device")
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=64),
+                      mx.metric.Accuracy())
+    acc = dict(score)["accuracy"]
+    assert acc >= 0.9, "DP fit under-converged: %f" % acc
+
+
+def test_indivisible_batch_raises():
+    ctxs = [mx.cpu(i) for i in range(3)]
+    mod = mx.mod.Module(_mlp(), label_names=("softmax_label",), context=ctxs)
+    with pytest.raises(mx.MXNetError):
+        mod.bind(data_shapes=[("data", (16, 10))],
+                 label_shapes=[("softmax_label", (16,))])

@@ -1,0 +1,156 @@
+"""NDArray imperative surface tests (reference: tests/python/unittest/test_ndarray.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, same
+
+
+def test_creation_and_basic_props():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.size == 4
+    assert a.ndim == 2
+    assert a.dtype == np.float32
+    assert same(a, np.array([[1, 2], [3, 4]], dtype=np.float32))
+
+
+def test_zeros_ones_full_arange():
+    assert same(nd.zeros((2, 3)), np.zeros((2, 3), np.float32))
+    assert same(nd.ones((3,)), np.ones(3, np.float32))
+    assert same(nd.arange(0, 10, 2), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_elementwise_arith():
+    a_np = np.random.randn(3, 4).astype(np.float32)
+    b_np = np.random.randn(3, 4).astype(np.float32)
+    a, b = nd.array(a_np), nd.array(b_np)
+    assert_almost_equal(a + b, a_np + b_np)
+    assert_almost_equal(a - b, a_np - b_np)
+    assert_almost_equal(a * b, a_np * b_np)
+    assert_almost_equal(a / b, a_np / b_np, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(a + 2.5, a_np + 2.5)
+    assert_almost_equal(2.5 - a, 2.5 - a_np)
+    assert_almost_equal(-a, -a_np)
+    assert_almost_equal(abs(a), np.abs(a_np))
+
+
+def test_inplace_ops():
+    a_np = np.ones((2, 2), np.float32)
+    a = nd.array(a_np)
+    a += 3
+    assert_almost_equal(a, a_np + 3)
+    a *= 2
+    assert_almost_equal(a, (a_np + 3) * 2)
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([3.0, 2.0, 1.0])
+    assert same(a == b, np.array([0, 1, 0], np.float32))
+    assert same(a > b, np.array([0, 0, 1], np.float32))
+    assert same(a <= b, np.array([1, 1, 0], np.float32))
+
+
+def test_indexing_and_setitem():
+    a_np = np.arange(12, dtype=np.float32).reshape(3, 4)
+    a = nd.array(a_np)
+    assert_almost_equal(a[1], a_np[1])
+    assert_almost_equal(a[1:3], a_np[1:3])
+    a[0] = 42.0
+    a_np[0] = 42.0
+    assert_almost_equal(a, a_np)
+
+
+def test_slice_returns_copy_documented_deviation():
+    # Deviation from reference ndarray.h:286-352 (zero-copy Slice): our slices
+    # are copies; writes to a slice do NOT propagate to the parent.
+    a = nd.array(np.arange(6, dtype=np.float32))
+    s = a.slice(0, 3)
+    s[:] = 99.0
+    assert a.asnumpy()[0] == 0.0
+
+
+def test_reshape_transpose():
+    a_np = np.arange(6, dtype=np.float32).reshape(2, 3)
+    a = nd.array(a_np)
+    assert_almost_equal(a.reshape((3, 2)), a_np.reshape(3, 2))
+    assert_almost_equal(a.T, a_np.T)
+
+
+def test_astype_copyto():
+    a = nd.array([1.5, 2.5])
+    b = a.astype(np.int32)
+    assert b.dtype == np.int32
+    c = nd.zeros((2,))
+    a.copyto(c)
+    assert_almost_equal(c, a)
+
+
+def test_dot():
+    a_np = np.random.randn(3, 4).astype(np.float32)
+    b_np = np.random.randn(4, 5).astype(np.float32)
+    out = nd.dot(nd.array(a_np), nd.array(b_np))
+    assert_almost_equal(out, a_np @ b_np, rtol=1e-4, atol=1e-4)
+
+
+def test_broadcast_ops():
+    a_np = np.random.randn(3, 1).astype(np.float32)
+    b_np = np.random.randn(1, 4).astype(np.float32)
+    out = nd.broadcast_add(nd.array(a_np), nd.array(b_np))
+    assert_almost_equal(out, a_np + b_np)
+
+
+def test_reduce_ops():
+    a_np = np.random.randn(2, 3, 4).astype(np.float32)
+    a = nd.array(a_np)
+    assert_almost_equal(nd.sum(a, axis=1), a_np.sum(axis=1), rtol=1e-5, atol=1e-5)
+    assert_almost_equal(nd.max(a, axis=(0, 2)), a_np.max(axis=(0, 2)))
+    assert_almost_equal(nd.mean(a), a_np.mean(), rtol=1e-5, atol=1e-6)
+
+
+def test_save_load_roundtrip():
+    arrays = {"w": nd.array(np.random.randn(3, 3).astype(np.float32)),
+              "b": nd.array(np.array([1, 2, 3], dtype=np.int32))}
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "test.params")
+        nd.save(path, arrays)
+        loaded = nd.load(path)
+    assert set(loaded) == {"w", "b"}
+    assert loaded["b"].dtype == np.int32  # dtype preserved (ADVICE fix)
+    assert_almost_equal(loaded["w"], arrays["w"])
+    assert_almost_equal(loaded["b"], arrays["b"])
+
+
+def test_save_load_list():
+    arrays = [nd.array([1.0, 2.0]), nd.array([[3.0]])]
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "list.params")
+        nd.save(path, arrays)
+        loaded = nd.load(path)
+    assert isinstance(loaded, list) and len(loaded) == 2
+    assert_almost_equal(loaded[0], arrays[0])
+
+
+def test_waitall():
+    a = nd.array([1.0]) + 1
+    nd.waitall()
+    assert a.asnumpy()[0] == 2.0
+
+
+def test_concat_stack():
+    a_np = np.random.randn(2, 3).astype(np.float32)
+    b_np = np.random.randn(2, 3).astype(np.float32)
+    out = nd.concat(nd.array(a_np), nd.array(b_np), dim=0)
+    assert_almost_equal(out, np.concatenate([a_np, b_np], axis=0))
+
+
+def test_onehot_encode():
+    idx = nd.array([0.0, 2.0])
+    out = nd.one_hot(idx, depth=3)
+    assert_almost_equal(out, np.eye(3, dtype=np.float32)[[0, 2]])

@@ -1,0 +1,417 @@
+"""Op math vs numpy + numeric-gradient checks per op family
+(reference: tests/python/unittest/test_operator.py + test_utils harness)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import (
+    assert_almost_equal, check_consistency, check_numeric_gradient,
+    check_symbolic_backward, check_symbolic_forward)
+
+
+# ---------------------------------------------------------------------------
+# elemwise family
+# ---------------------------------------------------------------------------
+
+def test_unary_forward():
+    x = np.random.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    for name, ref in [("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+                      ("square", np.square), ("abs", np.abs),
+                      ("sign", np.sign), ("floor", np.floor),
+                      ("ceil", np.ceil), ("tanh", np.tanh),
+                      ("sin", np.sin), ("cos", np.cos)]:
+        data = sym.Variable("data")
+        s = getattr(sym, name)(data=data)
+        check_symbolic_forward(s, {"data": x}, [ref(x)], rtol=1e-4, atol=1e-5)
+
+
+def test_unary_gradient():
+    x = np.random.uniform(0.5, 1.5, (2, 3)).astype(np.float64)
+    for name in ["exp", "log", "sqrt", "tanh", "sigmoid"]:
+        data = sym.Variable("data")
+        s = getattr(sym, name)(data=data)
+        check_numeric_gradient(s, {"data": x})
+
+
+def test_binary_broadcast_gradient():
+    a = np.random.uniform(0.5, 1.5, (2, 3)).astype(np.float64)
+    b = np.random.uniform(0.5, 1.5, (1, 3)).astype(np.float64)
+    for op in ["broadcast_add", "broadcast_mul", "broadcast_sub",
+               "broadcast_div"]:
+        lhs, rhs = sym.Variable("lhs"), sym.Variable("rhs")
+        s = getattr(sym, op)(lhs=lhs, rhs=rhs)
+        check_numeric_gradient(s, {"lhs": a, "rhs": b})
+
+
+def test_scalar_ops():
+    x = np.random.randn(3, 3).astype(np.float32)
+    data = sym.Variable("data")
+    check_symbolic_forward(data + 2.0, {"data": x}, [x + 2.0])
+    check_symbolic_forward(2.0 / (data + 3.0), {"data": x}, [2.0 / (x + 3.0)],
+                           rtol=1e-4, atol=1e-5)
+    check_symbolic_forward(data ** 2.0, {"data": x}, [x ** 2.0], rtol=1e-4,
+                           atol=1e-5)
+
+
+def test_smooth_l1():
+    x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], dtype=np.float32)
+    data = sym.Variable("data")
+    s = sym.smooth_l1(data=data, scalar=1.0)
+    expected = np.where(np.abs(x) < 1.0, 0.5 * x * x, np.abs(x) - 0.5)
+    check_symbolic_forward(s, {"data": x}, [expected.astype(np.float32)])
+
+
+# ---------------------------------------------------------------------------
+# reduce family
+# ---------------------------------------------------------------------------
+
+def test_reduce_forward_backward():
+    x = np.random.randn(2, 3, 4).astype(np.float64)
+    data = sym.Variable("data")
+    check_symbolic_forward(sym.sum(data=data, axis=1), {"data": x},
+                           [x.sum(axis=1)], rtol=1e-5, atol=1e-5)
+    check_symbolic_forward(sym.mean(data=data, axis=(0, 2)), {"data": x},
+                           [x.mean(axis=(0, 2))], rtol=1e-5, atol=1e-5)
+    check_numeric_gradient(sym.sum(data=data, axis=1), {"data": x})
+    check_symbolic_forward(sym.sum(data=data, axis=1, keepdims=True),
+                           {"data": x}, [x.sum(axis=1, keepdims=True)],
+                           rtol=1e-5, atol=1e-5)
+
+
+def test_argmax_argmin():
+    x = np.random.randn(3, 5).astype(np.float32)
+    data = sym.Variable("data")
+    check_symbolic_forward(sym.argmax(data=data, axis=1), {"data": x},
+                           [x.argmax(axis=1).astype(np.float32)])
+    check_symbolic_forward(sym.argmin(data=data, axis=0), {"data": x},
+                           [x.argmin(axis=0).astype(np.float32)])
+
+
+# ---------------------------------------------------------------------------
+# matrix family
+# ---------------------------------------------------------------------------
+
+def test_dot_and_batch_dot():
+    a = np.random.randn(3, 4).astype(np.float64)
+    b = np.random.randn(4, 5).astype(np.float64)
+    lhs, rhs = sym.Variable("lhs"), sym.Variable("rhs")
+    s = sym.dot(lhs=lhs, rhs=rhs)
+    check_symbolic_forward(s, {"lhs": a, "rhs": b}, [a @ b], rtol=1e-4,
+                           atol=1e-4)
+    check_numeric_gradient(s, {"lhs": a, "rhs": b}, rtol=2e-2, atol=2e-3)
+
+    a3 = np.random.randn(2, 3, 4).astype(np.float32)
+    b3 = np.random.randn(2, 4, 5).astype(np.float32)
+    s = sym.batch_dot(lhs=lhs, rhs=rhs)
+    check_symbolic_forward(s, {"lhs": a3, "rhs": b3}, [a3 @ b3], rtol=1e-4,
+                           atol=1e-4)
+
+
+def test_transpose_reshape_slice():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    data = sym.Variable("data")
+    check_symbolic_forward(sym.transpose(data=data, axes=(2, 0, 1)),
+                           {"data": x}, [x.transpose(2, 0, 1)])
+    check_symbolic_forward(sym.Reshape(data=data, shape=(6, 4)), {"data": x},
+                           [x.reshape(6, 4)])
+    check_symbolic_forward(sym.slice_axis(data=data, axis=1, begin=0, end=2),
+                           {"data": x}, [x[:, 0:2]])
+    check_symbolic_forward(sym.Flatten(data=data), {"data": x},
+                           [x.reshape(2, 12)])
+
+
+def test_clip_where_tile_repeat():
+    x = np.random.randn(3, 4).astype(np.float32)
+    data = sym.Variable("data")
+    check_symbolic_forward(sym.clip(data=data, a_min=-0.5, a_max=0.5),
+                           {"data": x}, [np.clip(x, -0.5, 0.5)])
+    check_symbolic_forward(sym.tile(data=data, reps=(2, 1)), {"data": x},
+                           [np.tile(x, (2, 1))])
+    check_symbolic_forward(sym.repeat(data=data, repeats=2, axis=1),
+                           {"data": x}, [np.repeat(x, 2, axis=1)])
+
+
+def test_swapaxis_expanddims():
+    x = np.random.randn(2, 3, 4).astype(np.float32)
+    data = sym.Variable("data")
+    check_symbolic_forward(sym.SwapAxis(data=data, dim1=0, dim2=2),
+                           {"data": x}, [np.swapaxes(x, 0, 2)])
+    check_symbolic_forward(sym.expand_dims(data=data, axis=1), {"data": x},
+                           [np.expand_dims(x, 1)])
+
+
+# ---------------------------------------------------------------------------
+# indexing family
+# ---------------------------------------------------------------------------
+
+def test_embedding_and_take():
+    weight = np.random.randn(10, 4).astype(np.float64)
+    idx = np.array([1.0, 3.0, 1.0, 7.0], dtype=np.float64)
+    data, w = sym.Variable("data"), sym.Variable("weight")
+    s = sym.Embedding(data=data, weight=w, input_dim=10, output_dim=4)
+    check_symbolic_forward(s, {"data": idx, "weight": weight},
+                           [weight[idx.astype(int)]], rtol=1e-5, atol=1e-6)
+    # gradient wrt weight only (indices not differentiable)
+    check_numeric_gradient(s, {"data": idx, "weight": weight},
+                           grad_nodes=["weight"])
+
+    a = np.random.randn(5, 3).astype(np.float32)
+    tidx = np.array([1.0, 3.0, 0.0, 4.0], dtype=np.float32)
+    check_symbolic_forward(sym.take(a=sym.Variable("a"),
+                                    indices=sym.Variable("indices")),
+                           {"a": a, "indices": tidx},
+                           [a[tidx.astype(int)]])
+
+
+def test_one_hot_pick():
+    idx = np.array([0.0, 2.0, 1.0], dtype=np.float32)
+    data = sym.Variable("data")
+    check_symbolic_forward(sym.one_hot(indices=data, depth=3), {"data": idx},
+                           [np.eye(3, dtype=np.float32)[idx.astype(int)]])
+    x = np.random.randn(3, 4).astype(np.float32)
+    s = sym.pick(data=sym.Variable("x"), index=sym.Variable("idx"), axis=1)
+    check_symbolic_forward(
+        s, {"x": x, "idx": np.array([1.0, 0.0, 3.0], np.float32)},
+        [x[np.arange(3), [1, 0, 3]]])
+
+
+# ---------------------------------------------------------------------------
+# ordering family
+# ---------------------------------------------------------------------------
+
+def test_topk_sort_argsort():
+    x = np.random.randn(3, 6).astype(np.float32)
+    data = sym.Variable("data")
+    out = sym.topk(data=data, k=2, axis=1)
+    expected = np.argsort(-x, axis=1, kind="stable")[:, :2].astype(np.float32)
+    check_symbolic_forward(out, {"data": x}, [expected])
+    check_symbolic_forward(sym.sort(data=data, axis=1), {"data": x},
+                           [np.sort(x, axis=1)])
+    check_symbolic_forward(sym.argsort(data=data, axis=1), {"data": x},
+                           [np.argsort(x, axis=1, kind="stable").astype(np.float32)])
+
+
+# ---------------------------------------------------------------------------
+# nn family
+# ---------------------------------------------------------------------------
+
+def test_fully_connected():
+    x = np.random.randn(4, 5).astype(np.float64)
+    w = np.random.randn(3, 5).astype(np.float64)
+    b = np.random.randn(3).astype(np.float64)
+    data = sym.Variable("data")
+    s = sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    check_symbolic_forward(s, {"data": x, "fc_weight": w, "fc_bias": b},
+                           [x @ w.T + b], rtol=1e-4, atol=1e-4)
+    check_numeric_gradient(s, {"data": x, "fc_weight": w, "fc_bias": b},
+                           rtol=2e-2, atol=2e-3)
+
+
+def test_activation():
+    x = np.random.randn(3, 4).astype(np.float32)
+    data = sym.Variable("data")
+    for act, ref in [("relu", lambda v: np.maximum(v, 0)),
+                     ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+                     ("tanh", np.tanh),
+                     ("softrelu", lambda v: np.log1p(np.exp(v)))]:
+        s = sym.Activation(data=data, act_type=act)
+        check_symbolic_forward(s, {"data": x}, [ref(x).astype(np.float32)],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_convolution_vs_reference_math():
+    # 1x1 conv == per-pixel matmul; exact oracle without torch
+    x = np.random.randn(2, 3, 5, 5).astype(np.float32)
+    w = np.random.randn(4, 3, 1, 1).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    data = sym.Variable("data")
+    s = sym.Convolution(data=data, num_filter=4, kernel=(1, 1), name="conv")
+    expected = np.einsum("nchw,kc->nkhw", x, w[:, :, 0, 0])
+    check_symbolic_forward(s, {"data": x, "conv_weight": w, "conv_bias": b},
+                           [expected], rtol=1e-4, atol=1e-4)
+
+
+def test_convolution_gradient():
+    x = np.random.randn(1, 2, 4, 4).astype(np.float64)
+    w = np.random.randn(2, 2, 3, 3).astype(np.float64)
+    b = np.random.randn(2).astype(np.float64)
+    data = sym.Variable("data")
+    s = sym.Convolution(data=data, num_filter=2, kernel=(3, 3), pad=(1, 1),
+                        name="conv")
+    check_numeric_gradient(s, {"data": x, "conv_weight": w, "conv_bias": b},
+                           rtol=3e-2, atol=4e-3)
+
+
+def test_convolution_torch_oracle():
+    torch = pytest.importorskip("torch")
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    w = np.random.randn(5, 3, 3, 3).astype(np.float32)
+    b = np.random.randn(5).astype(np.float32)
+    ref = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+        stride=2, padding=1).numpy()
+    data = sym.Variable("data")
+    s = sym.Convolution(data=data, num_filter=5, kernel=(3, 3), stride=(2, 2),
+                        pad=(1, 1), name="conv")
+    check_symbolic_forward(s, {"data": x, "conv_weight": w, "conv_bias": b},
+                           [ref], rtol=1e-3, atol=1e-3)
+
+
+def test_pooling():
+    torch = pytest.importorskip("torch")
+    x = np.random.randn(2, 3, 6, 6).astype(np.float32)
+    data = sym.Variable("data")
+    for pool_type, tfn in [("max", torch.nn.functional.max_pool2d),
+                           ("avg", torch.nn.functional.avg_pool2d)]:
+        s = sym.Pooling(data=data, pool_type=pool_type, kernel=(2, 2),
+                        stride=(2, 2))
+        ref = tfn(torch.from_numpy(x), 2, 2).numpy()
+        check_symbolic_forward(s, {"data": x}, [ref], rtol=1e-4, atol=1e-5)
+    s = sym.Pooling(data=data, global_pool=True, pool_type="avg", kernel=(1, 1))
+    check_symbolic_forward(s, {"data": x}, [x.mean(axis=(2, 3), keepdims=True)],
+                           rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_forward():
+    x = np.random.randn(4, 3, 5, 5).astype(np.float32)
+    gamma = np.random.uniform(0.5, 1.5, 3).astype(np.float32)
+    beta = np.random.randn(3).astype(np.float32)
+    data = sym.Variable("data")
+    s = sym.BatchNorm(data=data, eps=1e-3, fix_gamma=False, name="bn")
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    expected = ((x - mean[None, :, None, None]) /
+                np.sqrt(var[None, :, None, None] + 1e-3) *
+                gamma[None, :, None, None] + beta[None, :, None, None])
+    exe = s.bind(mx.cpu(), {"data": nd.array(x), "bn_gamma": nd.array(gamma),
+                            "bn_beta": nd.array(beta)},
+                 aux_states={"bn_moving_mean": nd.zeros((3,)),
+                             "bn_moving_var": nd.ones((3,))})
+    out = exe.forward(is_train=True)[0]
+    assert_almost_equal(out, expected, rtol=1e-3, atol=1e-3)
+    # aux moving stats updated toward batch stats
+    mm = exe.aux_dict["bn_moving_mean"].asnumpy()
+    assert np.abs(mm).sum() > 0
+
+
+def test_dropout_train_vs_test():
+    x = np.ones((100, 100), np.float32)
+    data = sym.Variable("data")
+    s = sym.Dropout(data=data, p=0.5)
+    exe = s.bind(mx.cpu(), {"data": nd.array(x)})
+    out_test = exe.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(out_test, x)  # identity at inference
+    out_train = exe.forward(is_train=True)[0].asnumpy()
+    frac_zero = (out_train == 0).mean()
+    assert 0.4 < frac_zero < 0.6
+    # inverted dropout: survivors scaled by 1/(1-p)
+    assert_almost_equal(out_train[out_train != 0],
+                        np.full((out_train != 0).sum(), 2.0, np.float32))
+
+
+def test_softmax_output_and_grad():
+    x = np.random.randn(4, 3).astype(np.float32)
+    label = np.array([0.0, 2.0, 1.0, 1.0], np.float32)
+    data = sym.Variable("data")
+    s = sym.SoftmaxOutput(data=data, name="softmax")
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    exe = s.bind(mx.cpu(), {"data": nd.array(x),
+                            "softmax_label": nd.array(label)},
+                 args_grad={"data": nd.zeros((4, 3))})
+    out = exe.forward(is_train=True)[0]
+    assert_almost_equal(out, p, rtol=1e-4, atol=1e-5)
+    exe.backward()
+    expected_grad = p.copy()
+    expected_grad[np.arange(4), label.astype(int)] -= 1.0
+    assert_almost_equal(exe.grad_dict["data"], expected_grad / 1.0, rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_regression_outputs():
+    x = np.random.randn(4, 2).astype(np.float32)
+    label = np.random.randn(4, 2).astype(np.float32)
+    data, lab = sym.Variable("data"), sym.Variable("label")
+    s = sym.LinearRegressionOutput(data=data, label=lab)
+    exe = s.bind(mx.cpu(), {"data": nd.array(x), "label": nd.array(label)},
+                 args_grad={"data": nd.zeros((4, 2))})
+    out = exe.forward(is_train=True)[0]
+    assert_almost_equal(out, x)
+    exe.backward()
+    # reference regression_output-inl.h:70-77: grad = (out-label)/num_output
+    # where num_output = label.size/batch = 2 here
+    assert_almost_equal(exe.grad_dict["data"], (x - label) / 2.0, rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_leaky_relu():
+    x = np.random.randn(3, 4).astype(np.float32)
+    data = sym.Variable("data")
+    s = sym.LeakyReLU(data=data, act_type="leaky", slope=0.1)
+    check_symbolic_forward(s, {"data": x},
+                           [np.where(x > 0, x, 0.1 * x).astype(np.float32)])
+
+
+def test_concat_slicechannel():
+    a = np.random.randn(2, 3).astype(np.float32)
+    b = np.random.randn(2, 4).astype(np.float32)
+    s = sym.Concat(sym.Variable("a"), sym.Variable("b"), dim=1)
+    check_symbolic_forward(s, {"a": a, "b": b},
+                           [np.concatenate([a, b], axis=1)])
+    x = np.random.randn(2, 6).astype(np.float32)
+    s = sym.SliceChannel(data=sym.Variable("x"), num_outputs=3, axis=1)
+    check_symbolic_forward(s, {"x": x}, [x[:, 0:2], x[:, 2:4], x[:, 4:6]])
+
+
+def test_sequence_ops():
+    x = np.random.randn(4, 2, 3).astype(np.float32)  # (seq, batch, feat)
+    length = np.array([2.0, 4.0], np.float32)
+    data, lens = sym.Variable("data"), sym.Variable("len")
+    s = sym.SequenceMask(data=data, sequence_length=lens,
+                         use_sequence_length=True)
+    expected = x.copy()
+    expected[2:, 0] = 0.0
+    check_symbolic_forward(s, {"data": x, "len": length}, [expected])
+    s = sym.SequenceLast(data=data, sequence_length=lens,
+                         use_sequence_length=True)
+    check_symbolic_forward(s, {"data": x, "len": length},
+                           [np.stack([x[1, 0], x[3, 1]])])
+
+
+def test_block_grad_stops_gradient():
+    x = np.random.randn(3, 3).astype(np.float64)
+    data = sym.Variable("data")
+    s = sym.BlockGrad(data=data * 2.0) + data
+    exe = s.bind(mx.cpu(), {"data": nd.array(x.astype(np.float32))},
+                 args_grad={"data": nd.zeros((3, 3))})
+    exe.forward(is_train=True)
+    exe.backward([nd.ones((3, 3))])
+    assert_almost_equal(exe.grad_dict["data"], np.ones((3, 3), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# sampling + consistency
+# ---------------------------------------------------------------------------
+
+def test_sample_ops_statistics():
+    s = sym.uniform(low=0.0, high=1.0, shape=(2000,))
+    exe = s.bind(mx.cpu(), {})
+    out = exe.forward(is_train=True)[0].asnumpy()
+    assert 0.0 <= out.min() and out.max() <= 1.0
+    assert abs(out.mean() - 0.5) < 0.05
+    s = sym.normal(loc=0.0, scale=1.0, shape=(2000,))
+    out = s.bind(mx.cpu(), {}).forward(is_train=True)[0].asnumpy()
+    assert abs(out.mean()) < 0.1 and abs(out.std() - 1.0) < 0.1
+
+
+def test_bf16_consistency():
+    x = np.random.randn(4, 8).astype(np.float32)
+    w = np.random.randn(6, 8).astype(np.float32)
+    b = np.random.randn(6).astype(np.float32)
+    data = sym.Variable("data")
+    s = sym.FullyConnected(data=data, num_hidden=6, name="fc")
+    s = sym.Activation(data=s, act_type="tanh")
+    check_consistency(s, {"data": x, "fc_weight": w, "fc_bias": b},
+                      dtypes=("float32", "bfloat16"), rtol=5e-2, atol=5e-2)

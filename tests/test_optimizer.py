@@ -1,0 +1,120 @@
+"""Optimizer update rules vs numpy references
+(reference: tests/python/unittest/test_optimizer.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run_updates(optr, w0, g, n=3):
+    w = nd.array(w0.copy())
+    state = optr.create_state(0, w)
+    for _ in range(n):
+        optr.update(0, w, nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = np.random.randn(4, 3).astype(np.float32)
+    g = np.random.randn(4, 3).astype(np.float32)
+    lr, wd = 0.1, 0.01
+    out = _run_updates(mx.optimizer.SGD(learning_rate=lr, wd=wd,
+                                        rescale_grad=1.0), w0, g)
+    w = w0.copy()
+    for _ in range(3):
+        w -= lr * (g + wd * w)
+    assert_almost_equal(out, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_matches_numpy():
+    w0 = np.random.randn(5).astype(np.float32)
+    g = np.random.randn(5).astype(np.float32)
+    lr, mom, wd = 0.1, 0.9, 0.0
+    out = _run_updates(mx.optimizer.SGD(learning_rate=lr, momentum=mom,
+                                        wd=wd, rescale_grad=1.0), w0, g)
+    w, v = w0.copy(), np.zeros_like(w0)
+    for _ in range(3):
+        # reference sgd_mom_update (optimizer_op-inl.h): v = m*v - lr*(g+wd*w)
+        v = mom * v - lr * (g + wd * w)
+        w += v
+    assert_almost_equal(out, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    w0 = np.random.randn(6).astype(np.float32)
+    g = np.random.randn(6).astype(np.float32)
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    out = _run_updates(mx.optimizer.Adam(learning_rate=lr, beta1=b1, beta2=b2,
+                                         epsilon=eps, wd=0.0,
+                                         rescale_grad=1.0), w0, g)
+    w = w0.copy()
+    m = np.zeros_like(w0)
+    v = np.zeros_like(w0)
+    for t in range(1, 4):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w -= lr_t * m / (np.sqrt(v) + eps)
+    assert_almost_equal(out, w, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop_runs_and_descends():
+    # loss = 0.5*||w||^2, grad = w: every optimizer should shrink the norm
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "nag",
+                 "sgld", "dcasgd"]:
+        optr = mx.optimizer.Optimizer.create_optimizer(
+            name, learning_rate=0.05, rescale_grad=1.0)
+        w = nd.array(np.ones(8, np.float32) * 5.0)
+        state = optr.create_state(0, w)
+        for _ in range(20):
+            optr.update(0, w, w.copy(), state)
+        final = np.abs(w.asnumpy()).mean()
+        assert final < 5.0, "%s did not descend (|w|=%f)" % (name, final)
+
+
+def test_lr_mult_and_wd_mult():
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    opt.set_lr_mult({"frozen": 0.0})
+    opt.idx2name = {0: "frozen", 1: "free"}
+    w_frozen = nd.array(np.ones(3, np.float32))
+    w_free = nd.array(np.ones(3, np.float32))
+    g = nd.array(np.ones(3, np.float32))
+    opt.update(0, w_frozen, g, opt.create_state(0, w_frozen))
+    opt.update(1, w_free, g, opt.create_state(1, w_free))
+    assert_almost_equal(w_frozen, np.ones(3))
+    assert float(np.abs(w_free.asnumpy() - 1.0).sum()) > 0
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    # reference lr_scheduler.py:36 drops lr only when num_update exceeds the
+    # step boundary (strict >)
+    assert abs(sched(5) - 1.0) < 1e-6
+    assert abs(sched(11) - 0.5) < 1e-6
+    assert abs(sched(25) - 0.25) < 1e-6
+    msched = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    msched.base_lr = 1.0
+    assert abs(msched(4) - 1.0) < 1e-6
+    assert abs(msched(6) - 0.1) < 1e-6
+    assert abs(msched(20) - 0.01) < 1e-6
+
+
+def test_updater_closure():
+    opt = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0)
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.array(np.ones(4, np.float32))
+    g = nd.array(np.full(4, 2.0, np.float32))
+    updater(0, g, w)
+    assert_almost_equal(w, np.ones(4) - 0.1 * 2.0, rtol=1e-5, atol=1e-6)
+
+
+def test_clip_gradient():
+    opt = mx.optimizer.SGD(learning_rate=1.0, clip_gradient=0.5,
+                           rescale_grad=1.0, wd=0.0)
+    w = nd.array(np.zeros(2, np.float32))
+    g = nd.array(np.array([10.0, -10.0], np.float32))
+    opt.update(0, w, g, opt.create_state(0, w))
+    assert_almost_equal(w, [-0.5, 0.5], rtol=1e-5, atol=1e-6)
