@@ -32,6 +32,7 @@ from . import kvstore as kv
 from . import model
 from . import module
 from . import module as mod
+from . import rnn
 from . import monitor
 from . import monitor as mon
 from . import visualization

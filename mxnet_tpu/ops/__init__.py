@@ -17,6 +17,7 @@ from . import sample  # noqa: F401
 from . import ordering  # noqa: F401
 from . import nn  # noqa: F401
 from . import sequence  # noqa: F401
+from . import rnn_op  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import spatial  # noqa: F401
 from . import contrib_ops  # noqa: F401

@@ -1,0 +1,179 @@
+"""RNN stack: fused RNN op, cell library, fused-vs-unfused oracle, bucketing
+iterator (reference: tests/python/unittest/test_rnn.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.ops.rnn_op import rnn_param_size
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@pytest.mark.parametrize("mode,nstate", [("rnn_relu", 1), ("rnn_tanh", 1),
+                                         ("lstm", 2), ("gru", 1)])
+def test_rnn_op_shapes(mode, nstate):
+    T, N, I, H, L = 7, 4, 5, 6, 2
+    ps = rnn_param_size(I, H, L, mode, True)
+    kwargs = dict(state_size=H, num_layers=L, bidirectional=True, mode=mode,
+                  state_outputs=True)
+    ins = dict(data=nd.array(np.random.randn(T, N, I).astype(np.float32)),
+               parameters=nd.array(
+                   0.1 * np.random.randn(ps).astype(np.float32)),
+               state=nd.zeros((L * 2, N, H)))
+    if mode == "lstm":
+        ins["state_cell"] = nd.zeros((L * 2, N, H))
+    outs = nd.RNN(**ins, **kwargs)
+    outs = outs if isinstance(outs, list) else [outs]
+    assert outs[0].shape == (T, N, 2 * H)
+    assert outs[1].shape == (L * 2, N, H)
+    if mode == "lstm":
+        assert outs[2].shape == (L * 2, N, H)
+
+
+@pytest.mark.parametrize("mode", ["rnn_tanh", "lstm", "gru"])
+def test_fused_vs_unfused(mode):
+    """FusedRNNCell (lax.scan kernel) must match its unfuse()d stack of
+    python cells, through pack/unpack weight conversion."""
+    T, N, I, H, L = 5, 3, 4, 6, 2
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode=mode,
+                                prefix="%s_" % mode)
+    data = sym.Variable("data")
+    fused_out, _ = fused.unroll(T, inputs=data, layout="NTC",
+                                merge_outputs=True)
+
+    stack = fused.unfuse()
+    unfused_out, _ = stack.unroll(T, inputs=data, layout="NTC",
+                                  merge_outputs=True)
+
+    x = np.random.randn(N, T, I).astype(np.float32)
+    ps = rnn_param_size(I, H, L, mode)
+    packed = 0.2 * np.random.randn(ps).astype(np.float32)
+    fused_args = {"data": nd.array(x),
+                  "%s_parameters" % mode: nd.array(packed)}
+    exe_f = fused_out.bind(mx.cpu(), fused_args)
+    out_f = exe_f.forward()[0].asnumpy()
+    assert out_f.shape == (N, T, H)
+
+    unpacked = fused.unpack_weights({"%s_parameters" % mode: packed})
+    unfused_args = {"data": nd.array(x)}
+    for k, v in unpacked.items():
+        unfused_args[k] = nd.array(v)
+    exe_u = unfused_out.bind(mx.cpu(), unfused_args)
+    out_u = exe_u.forward()[0].asnumpy()
+    assert_almost_equal(out_f, out_u, rtol=1e-4, atol=1e-5)
+
+    # pack round-trips
+    repacked = fused.pack_weights(unpacked)
+    np.testing.assert_allclose(repacked["%s_parameters" % mode], packed,
+                               rtol=1e-6)
+
+
+def test_fused_bidirectional_vs_unfused():
+    T, N, I, H = 4, 2, 3, 5
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm",
+                                bidirectional=True, prefix="bi_")
+    data = sym.Variable("data")
+    fused_out, _ = fused.unroll(T, inputs=data, layout="NTC",
+                                merge_outputs=True)
+    stack = fused.unfuse()
+    unfused_out, _ = stack.unroll(T, inputs=data, layout="NTC",
+                                  merge_outputs=True)
+
+    x = np.random.randn(N, T, I).astype(np.float32)
+    ps = rnn_param_size(I, H, 1, "lstm", True)
+    packed = 0.2 * np.random.randn(ps).astype(np.float32)
+    exe_f = fused_out.bind(mx.cpu(), {"data": nd.array(x),
+                                      "bi_parameters": nd.array(packed)})
+    out_f = exe_f.forward()[0].asnumpy()
+    assert out_f.shape == (N, T, 2 * H)
+
+    unpacked = fused.unpack_weights({"bi_parameters": packed})
+    args = {"data": nd.array(x)}
+    args.update({k: nd.array(v) for k, v in unpacked.items()})
+    exe_u = unfused_out.bind(mx.cpu(), args)
+    out_u = exe_u.forward()[0].asnumpy()
+    assert_almost_equal(out_f, out_u, rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_gradients_flow():
+    """Gradient through the fused kernel reaches data and parameters."""
+    T, N, I, H = 3, 2, 4, 5
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="g_")
+    data = sym.Variable("data")
+    out, _ = fused.unroll(T, inputs=data, layout="NTC", merge_outputs=True)
+    loss = sym.MakeLoss(sym.sum(out * out))
+    x = np.random.randn(N, T, I).astype(np.float32)
+    ps = rnn_param_size(I, H, 1, "lstm")
+    packed = 0.1 * np.random.randn(ps).astype(np.float32)
+    args = {"data": nd.array(x), "g_parameters": nd.array(packed)}
+    grads = {k: nd.zeros(v.shape) for k, v in args.items()}
+    exe = loss.bind(mx.cpu(), args, args_grad=grads)
+    exe.forward(is_train=True)
+    exe.backward()
+    assert np.abs(exe.grad_dict["g_parameters"].asnumpy()).sum() > 0
+    assert np.abs(exe.grad_dict["data"].asnumpy()).sum() > 0
+
+
+def test_cell_unroll_shapes():
+    cell = mx.rnn.LSTMCell(10, prefix="l_")
+    outputs, states = cell.unroll(3, input_prefix="t_")
+    assert len(outputs) == 3
+    assert len(states) == 2
+    _, out_shapes, _ = mx.sym.Group(outputs).infer_shape(
+        t_t0_data=(2, 7), t_t1_data=(2, 7), t_t2_data=(2, 7))
+    assert all(tuple(s) == (2, 10) for s in out_shapes)
+
+
+def test_sequential_stack():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(8, prefix="l0_"))
+    stack.add(mx.rnn.LSTMCell(8, prefix="l1_"))
+    data = sym.Variable("data")
+    out, states = stack.unroll(4, inputs=data, layout="NTC",
+                               merge_outputs=True)
+    assert len(states) == 4
+    x = np.random.randn(2, 4, 6).astype(np.float32)
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(2, 4, 6))
+    assert tuple(out_shapes[0]) == (2, 4, 8)
+
+
+def test_residual_and_dropout_cells():
+    base = mx.rnn.RNNCell(6, prefix="r_")
+    cell = mx.rnn.ResidualCell(base)
+    data = sym.Variable("data")
+    out, _ = cell.unroll(3, inputs=data, layout="NTC", merge_outputs=True)
+    _, out_shapes, _ = out.infer_shape(data=(2, 3, 6))
+    assert tuple(out_shapes[0]) == (2, 3, 6)
+
+    d = mx.rnn.DropoutCell(0.5)
+    o, s = d(sym.Variable("x"), [])
+    assert s == []
+
+
+def test_zoneout_cell():
+    base = mx.rnn.RNNCell(5, prefix="z_")
+    cell = mx.rnn.ZoneoutCell(base, zoneout_outputs=0.3, zoneout_states=0.3)
+    data = sym.Variable("data")
+    out, _ = cell.unroll(3, inputs=data, layout="NTC", merge_outputs=True)
+    _, out_shapes, _ = out.infer_shape(data=(2, 3, 5))
+    assert tuple(out_shapes[0]) == (2, 3, 5)
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [1, 1, 1], [2, 2],
+                 [3, 3, 3, 3]] * 4
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                   buckets=[3, 5], invalid_label=0)
+    seen = 0
+    for batch in it:
+        assert batch.bucket_key in (3, 5)
+        assert batch.data[0].shape == (4, batch.bucket_key)
+        assert batch.label[0].shape == (4, batch.bucket_key)
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        np.testing.assert_allclose(l[:, :-1], d[:, 1:])
+        seen += 1
+    assert seen >= 2
+    it.reset()
+    assert sum(1 for _ in it) == seen
