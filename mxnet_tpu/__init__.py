@@ -33,6 +33,7 @@ from . import model
 from . import module
 from . import module as mod
 from . import rnn
+from . import operator
 from . import monitor
 from . import monitor as mon
 from . import visualization

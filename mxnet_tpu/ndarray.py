@@ -366,7 +366,7 @@ def _invoke(op_name: str, args, kwargs):
     if op.key_var_num_args and op.key_var_num_args not in attrs:
         attrs[op.key_var_num_args] = len(pos_inputs)
     parsed = op.parse_attrs(attrs)
-    names = op.input_names(parsed) + list(op.aux)
+    names = op.input_names(parsed) + op.aux_names(parsed)
     inputs = list(pos_inputs)
     if nd_kwargs:
         slot = {n: a for n, a in zip(names, inputs)}
@@ -385,7 +385,7 @@ def _invoke(op_name: str, args, kwargs):
         else:
             ctx = current_context()
     jarrs = [a._data if isinstance(a, NDArray) else _as_jax(a) for a in inputs]
-    n_aux = len(op.aux)
+    n_aux = len(op.aux_names(parsed))
     aux_in = tuple(jarrs[len(jarrs) - n_aux:]) if n_aux else ()
     main_in = jarrs[: len(jarrs) - n_aux] if n_aux else jarrs
     opctx = OpContext(is_train=False,
@@ -421,7 +421,7 @@ def _init_ops():
     for name, op in registered_ops().items():
         fn = _make_imperative(name, op)
         g[name] = fn
-        if name.startswith("_"):
+        if name.startswith("_") or name in __all__:
             continue
         __all__.append(name)
 

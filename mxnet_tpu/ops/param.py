@@ -112,8 +112,13 @@ def _parse_shape(value, cast=int):
 
 
 def parse_attrs(spec: Optional[Dict[str, Param]], attrs: Dict[str, Any],
-                op_name: str = "") -> Dict[str, Any]:
-    """Coerce raw attrs (strings or python values) against the spec."""
+                op_name: str = "", allow_extra: bool = False) -> Dict[str, Any]:
+    """Coerce raw attrs (strings or python values) against the spec.
+
+    ``allow_extra``: keep unknown attrs as strings instead of rejecting —
+    the Custom op forwards arbitrary user kwargs to the CustomOpProp
+    constructor as strings (reference: src/operator/custom/custom.cc
+    attr_parser passes raw kwargs through to the Python prop)."""
     out: Dict[str, Any] = {}
     spec = spec or {}
     for key, param in spec.items():
@@ -131,6 +136,8 @@ def parse_attrs(spec: Optional[Dict[str, Param]], attrs: Dict[str, Any],
         if key not in out:
             if key.startswith("__") or key in ("ctx", "name"):
                 out[key] = value
+            elif allow_extra:
+                out[key] = value if isinstance(value, str) else str(value)
             else:
                 raise ValueError(
                     "unknown argument %r for operator %s" % (key, op_name))

@@ -55,6 +55,7 @@ class Op:
         hint: Optional[str] = None,
         no_grad_inputs: Sequence[str] = (),
         aux_dtype: Optional[str] = None,
+        allow_extra_attrs: bool = False,
         doc: str = "",
     ):
         self.name = name
@@ -62,7 +63,10 @@ class Op:
         self._inputs = inputs
         self.params = params or {}
         self._num_outputs = num_outputs
-        self.aux = tuple(aux)
+        # aux may be a callable(attrs) -> names for ops whose auxiliary-state
+        # list depends on attrs (the Custom op: CustomOpProp.list_auxiliary_states)
+        self.aux = aux if callable(aux) else tuple(aux)
+        self.allow_extra_attrs = allow_extra_attrs
         self.stochastic = stochastic
         self.key_var_num_args = key_var_num_args
         self.infer_shape = infer_shape
@@ -98,10 +102,16 @@ class Op:
             return ["%s_output" % node_name]
         return ["%s_output%d" % (node_name, i) for i in range(n)]
 
+    def aux_names(self, attrs: Dict[str, Any]) -> List[str]:
+        if callable(self.aux):
+            return list(self.aux(attrs))
+        return list(self.aux)
+
     def parse_attrs(self, attrs: Dict[str, Any]) -> Dict[str, Any]:
         from .param import parse_attrs
 
-        return parse_attrs(self.params, attrs, self.name)
+        return parse_attrs(self.params, attrs, self.name,
+                           allow_extra=self.allow_extra_attrs)
 
     # -- application -------------------------------------------------------
     def apply(self, opctx: OpContext, attrs: Dict[str, Any], inputs, aux=()):
@@ -110,7 +120,7 @@ class Op:
         if not isinstance(result, tuple):
             result = (result,)
         n_out = self.num_outputs(attrs)
-        n_aux = len(self.aux)
+        n_aux = len(aux)
         if n_aux and len(result) == n_out + n_aux:
             return result[:n_out], result[n_out:]
         return result, tuple(aux)

@@ -57,7 +57,8 @@ class _Node:
         if self.op is None or not self.op.aux:
             return []
         if self._aux_names is None:
-            self._aux_names = ["%s_%s" % (self.name, a) for a in self.op.aux]
+            self._aux_names = ["%s_%s" % (self.name, a)
+                               for a in self.op.aux_names(self.attrs)]
         return self._aux_names
 
 
@@ -344,8 +345,11 @@ class Symbol:
                 jnodes.append({"op": "null", "name": n.name,
                                "attr": dict(n.attr_dict), "inputs": []})
             else:
-                attr = attrs_to_strs({k: v for k, v in n.attrs.items()
-                                      if n.op.params and k in n.op.params})
+                attr = attrs_to_strs({
+                    k: v for k, v in n.attrs.items()
+                    if (n.op.params and k in n.op.params) or
+                    (n.op.allow_extra_attrs and not k.startswith("__") and
+                     k not in ("ctx", "name") and v is not None)})
                 attr.update(n.attr_dict)
                 jnodes.append({
                     "op": n.op.name, "name": n.name, "attr": attr,
@@ -488,7 +492,7 @@ def _forward_infer(sym: Symbol, known: Dict[str, Tuple], types_only=False):
                 structs = [
                     jax.ShapeDtypeStruct(s, t if t is not None else np.float32)
                     for s, t in in_infos]
-                n_aux = len(n.op.aux)
+                n_aux = len(n.op.aux_names(n.attrs))
                 if n_aux:
                     known_aux = [aux_shapes.get(a) for a in n.aux_names()]
                     if any(a is None for a in known_aux):
@@ -546,7 +550,7 @@ def _forward_infer(sym: Symbol, known: Dict[str, Tuple], types_only=False):
 def _abstract_apply(op, attrs, structs):
     import jax
 
-    n_aux = len(op.aux)
+    n_aux = len(op.aux_names(attrs))
 
     def fn(*arrs):
         main = arrs[: len(arrs) - n_aux] if n_aux else arrs
@@ -662,9 +666,11 @@ def load_json(json_str: str) -> Symbol:
             # through as node attributes instead of raising — matches the
             # reference, where node attrs and op params share one string map.
             param_attrs = {k: v for k, v in attr.items()
-                           if not k.startswith("__") and k in op.params}
+                           if not k.startswith("__") and
+                           (k in op.params or op.allow_extra_attrs)}
             graph_attrs = {k: v for k, v in attr.items()
-                           if k.startswith("__") or k not in op.params}
+                           if k.startswith("__") or
+                           (k not in op.params and not op.allow_extra_attrs)}
             parsed = op.parse_attrs(param_attrs)
             inputs = [(nodes[i[0]], i[1]) for i in jn["inputs"]]
             nodes.append(_Node(op, jn["name"], parsed, inputs, graph_attrs))

@@ -1,0 +1,197 @@
+"""Custom-op subsystem tests (reference: the custom softmax in
+tests/python/unittest/test_operator.py and python/mxnet/operator.py:396-576)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@mx.operator.register("_test_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super(SigmoidProp, self).__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class Sigmoid(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                y = 1.0 / (1.0 + np.exp(-x))
+                self.assign(out_data[0], req[0], y.astype(x.dtype))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                y = out_data[0].asnumpy()
+                g = out_grad[0].asnumpy()
+                self.assign(in_grad[0], req[0],
+                            (g * y * (1.0 - y)).astype(y.dtype))
+
+        return Sigmoid()
+
+
+@mx.operator.register("_test_softmax_loss")
+class SoftmaxLossProp(mx.operator.CustomOpProp):
+    """Reference-style custom softmax loss (need_top_grad=False)."""
+
+    def __init__(self):
+        super(SoftmaxLossProp, self).__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = [in_shape[0][0]]
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class SoftmaxLoss(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                e = np.exp(x - x.max(axis=1, keepdims=True))
+                self.assign(out_data[0], req[0],
+                            (e / e.sum(axis=1, keepdims=True)).astype(x.dtype))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                lab = in_data[1].asnumpy().astype(np.int64)
+                y = out_data[0].asnumpy().copy()
+                y[np.arange(lab.shape[0]), lab] -= 1.0
+                self.assign(in_grad[0], req[0], y)
+                self.assign(in_grad[1], req[1],
+                            np.zeros_like(in_data[1].asnumpy()))
+
+        return SoftmaxLoss()
+
+
+def test_custom_imperative_forward():
+    x = nd.array(np.array([[0.0, 1.0], [-1.0, 2.0]], np.float32))
+    y = nd.Custom(x, op_type="_test_sigmoid")
+    expect = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+    assert_almost_equal(y.asnumpy(), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_custom_symbolic_forward_backward():
+    data = sym.Variable("data")
+    net = sym.Custom(data=data, op_type="_test_sigmoid", name="sig")
+    xe = np.random.uniform(-2, 2, (4, 5)).astype(np.float32)
+    exe = net.simple_bind(mx.cpu(), data=(4, 5), grad_req="write")
+    exe.arg_dict["data"][:] = xe
+    out = exe.forward(is_train=True)[0].asnumpy()
+    expect = 1.0 / (1.0 + np.exp(-xe))
+    assert_almost_equal(out, expect, rtol=1e-5, atol=1e-6)
+    head = np.random.uniform(-1, 1, (4, 5)).astype(np.float32)
+    exe.backward(nd.array(head))
+    grad = exe.grad_dict["data"].asnumpy()
+    assert_almost_equal(grad, head * expect * (1 - expect),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_custom_softmax_trains():
+    """End-to-end: a net with a custom softmax loss learns a separable toy
+    problem (reference nightly gate style)."""
+    np.random.seed(0)
+    n, d, k = 128, 10, 3
+    w_true = np.random.randn(d, k).astype(np.float32)
+    x = np.random.randn(n, d).astype(np.float32)
+    lab = (x @ w_true).argmax(axis=1).astype(np.float32)
+
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    net = sym.FullyConnected(data=data, num_hidden=k, name="fc")
+    net = sym.Custom(data=net, label=label, op_type="_test_softmax_loss",
+                     name="loss")
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=mx.cpu())
+    it = mx.io.NDArrayIter(data=x, label=lab, batch_size=32, shuffle=True,
+                           label_name="label")
+    mod.fit(it, num_epoch=10,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    mod.bind(data_shapes=[("data", (n, d))], label_shapes=[("label", (n,))],
+             for_training=False, force_rebind=True)
+    probs = mod.predict(mx.io.NDArrayIter(data=x, label=lab, batch_size=n,
+                                          label_name="label")).asnumpy()
+    acc = (probs.argmax(axis=1) == lab).mean()
+    assert acc > 0.9, "custom softmax failed to train: acc=%.3f" % acc
+
+
+def test_ndarray_op_legacy():
+    class Square(mx.operator.NDArrayOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] * in_data[0]
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0] * in_data[0] * 2.0
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+    op = Square()
+    data = sym.Variable("data")
+    net = op.get_symbol(data, name="sq")
+    xe = np.random.uniform(-2, 2, (3, 4)).astype(np.float32)
+    exe = net.simple_bind(mx.cpu(), data=(3, 4), grad_req="write")
+    exe.arg_dict["data"][:] = xe
+    out = exe.forward(is_train=True)[0].asnumpy()
+    assert_almost_equal(out, xe * xe, rtol=1e-5, atol=1e-6)
+    exe.backward(nd.array(np.ones((3, 4), np.float32)))
+    assert_almost_equal(exe.grad_dict["data"].asnumpy(), 2 * xe,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_python_op_legacy_numpy():
+    class AddOne(mx.operator.PythonOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] + 1.0
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0]
+
+    op = AddOne()
+    data = sym.Variable("data")
+    net = op.get_symbol(data, name="addone")
+    exe = net.simple_bind(mx.cpu(), data=(2, 2), grad_req="write")
+    exe.arg_dict["data"][:] = np.zeros((2, 2), np.float32)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(out, np.ones((2, 2), np.float32))
+
+
+@mx.operator.register("_test_scale")
+class ScaleProp(mx.operator.CustomOpProp):
+    def __init__(self, scale):
+        super(ScaleProp, self).__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        scale = self.scale
+
+        class Scale(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0].asnumpy() * scale)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0], out_grad[0].asnumpy() * scale)
+
+        return Scale()
+
+
+def test_custom_json_round_trip():
+    """Custom-op user kwargs must survive tojson/load_json (checkpointing)."""
+    data = sym.Variable("data")
+    net = sym.Custom(data=data, op_type="_test_scale", scale="3.0",
+                     name="sc")
+    js = net.tojson()
+    loaded = mx.sym.load_json(js)
+    x = np.random.uniform(-1, 1, (2, 3)).astype(np.float32)
+    exe = loaded.simple_bind(mx.cpu(), data=(2, 3), grad_req="write")
+    exe.arg_dict["data"][:] = x
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(out, x * 3.0, rtol=1e-5, atol=1e-6)
