@@ -34,6 +34,7 @@ from . import module
 from . import module as mod
 from . import rnn
 from . import operator
+from . import parallel
 from . import monitor
 from . import monitor as mon
 from . import visualization

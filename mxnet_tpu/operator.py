@@ -29,7 +29,13 @@ __all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_class",
 
 class CustomOp(object):
     """Base class for user operators. Subclass and implement
-    ``forward``/``backward``; use ``assign`` to honour the write request."""
+    ``forward``/``backward``; use ``assign`` to honour the write request.
+
+    Deviation from the reference: ``backward`` receives ``in_data``/
+    ``out_data`` explicitly (saved as vjp residuals), and one operator
+    instance may be shared by executors with identical input shapes — do
+    NOT stash per-batch state on ``self`` in ``forward`` for use in
+    ``backward``; recompute from the arrays that are passed in."""
 
     def forward(self, is_train, req, in_data, out_data, aux):
         raise NotImplementedError()
@@ -101,6 +107,10 @@ def register(reg_name):
     def do_register(prop_cls):
         with _registry_lock:
             _prop_registry[reg_name] = prop_cls
+            # re-registration under the same name (notebook workflows) must
+            # not keep serving cached props of the old class
+            for key in [k for k in _prop_cache if k[0] == reg_name]:
+                del _prop_cache[key]
         return prop_cls
 
     return do_register
@@ -122,8 +132,23 @@ def get_prop_class(reg_name: str) -> type:
 
 _RESERVED_ATTRS = ("ctx", "name", "op_type")
 
+
+class _LRU(dict):
+    """Tiny bounded cache — bucketing workloads create one entry per shape;
+    unbounded growth would pin every CustomOp instance forever."""
+
+    def __init__(self, maxsize=256):
+        super(_LRU, self).__init__()
+        self._maxsize = maxsize
+
+    def __setitem__(self, key, value):
+        if key not in self and len(self) >= self._maxsize:
+            del self[next(iter(self))]
+        super(_LRU, self).__setitem__(key, value)
+
+
 _prop_cache: Dict[Any, CustomOpProp] = {}
-_op_cache: Dict[Any, CustomOp] = {}
+_op_cache: Dict[Any, CustomOp] = _LRU()
 
 
 def _user_kwargs(attrs: Dict[str, Any]) -> Dict[str, str]:
@@ -189,7 +214,7 @@ def _out_struct(prop, main, aux):
     return out_struct, aux_struct
 
 
-_out_spec_cache: Dict[Any, Any] = {}
+_out_spec_cache: Dict[Any, Any] = _LRU()
 
 
 def _out_spec(prop, in_shapes, in_dtypes):

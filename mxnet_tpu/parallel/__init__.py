@@ -1,0 +1,33 @@
+"""mxnet_tpu.parallel — SPMD parallelism over TPU device meshes.
+
+This package is the TPU-native replacement for the reference's entire
+distribution plane (src/kvstore/comm.h, kvstore_dist.h, ps-lite, the
+DataParallelExecutorGroup scatter/gather in
+python/mxnet/module/executor_group.py:77-236, and the manual
+model-parallel-lstm layer placement in example/model-parallel-lstm/): instead
+of explicit push/pull and cross-device copies, parameters and activations
+carry sharding annotations over a named `jax.sharding.Mesh` and XLA compiles
+the collectives (psum/all_gather/reduce_scatter/ppermute) into the step
+function, riding ICI within a slice and DCN across slices.
+
+New-capability set beyond the reference (SURVEY.md §5.7, §7 step 8):
+
+* ``ring_attention`` — exact blockwise attention with keys/values rotating
+  around the mesh ring (ppermute), sequence-parallel long-context training.
+* ``ulysses_attention`` — all-to-all sequence parallelism (shard heads during
+  attention, sequence elsewhere).
+* ``pipeline_spmd`` — collective-permute pipeline over stacked homogeneous
+  stages (the TPU-native form of the reference's model-parallel LSTM
+  placement, example/model-parallel-lstm/lstm.py:142-205).
+"""
+from .mesh import (MeshConfig, make_mesh, data_parallel_mesh, shard, replicate,
+                   current_mesh, set_current_mesh)
+from .ring import ring_attention, ulysses_attention, local_attention
+from .pipeline import pipeline_spmd
+
+__all__ = [
+    "MeshConfig", "make_mesh", "data_parallel_mesh", "shard", "replicate",
+    "current_mesh", "set_current_mesh",
+    "ring_attention", "ulysses_attention", "local_attention",
+    "pipeline_spmd",
+]

@@ -1,0 +1,118 @@
+"""Device-mesh construction and sharding helpers.
+
+Replaces the reference's device bookkeeping (Context lists handed to
+DataParallelExecutorGroup, kvstore device groups — src/kvstore/comm.h:61-360)
+with one named mesh: axes are *roles* ('data', 'model', 'pipe', 'seq',
+'expert'), and placement is expressed as PartitionSpecs over those roles
+rather than explicit copies.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+#: canonical axis order — data-parallel outermost (maps to the slower/outer
+#: ICI dimensions last in the mesh tuple so model/seq collectives ride the
+#: fastest links; jax device order within a host is contiguous)
+CANONICAL_AXES = ("data", "pipe", "expert", "model", "seq")
+
+
+class MeshConfig:
+    """Declarative mesh spec: axis name → size. Size -1 means 'absorb the
+    remaining devices' (at most one axis may be -1)."""
+
+    def __init__(self, **axes: int):
+        self.axes = dict(axes)
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        axes = dict(self.axes)
+        unknown = [k for k, v in axes.items() if v == -1]
+        if len(unknown) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        known = int(np.prod([v for v in axes.values() if v != -1])) or 1
+        if unknown:
+            if n_devices % known:
+                raise ValueError(
+                    "cannot infer axis %r: %d devices not divisible by %d"
+                    % (unknown[0], n_devices, known))
+            axes[unknown[0]] = n_devices // known
+        total = int(np.prod(list(axes.values()))) if axes else 1
+        if total != n_devices:
+            raise ValueError(
+                "mesh %r uses %d devices but %d are available"
+                % (axes, total, n_devices))
+        return axes
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None):
+    """Build a named Mesh. ``axes`` maps axis name → size (-1 = remaining);
+    default is a pure data-parallel mesh over all devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if not axes:
+        axes = {"data": len(devices)}
+    resolved = MeshConfig(**axes).resolve(len(devices))
+    # order axes canonically so collectives on inner axes stay intra-group
+    names = sorted(resolved, key=lambda a: (
+        CANONICAL_AXES.index(a) if a in CANONICAL_AXES else len(CANONICAL_AXES)))
+    shape = [resolved[a] for a in names]
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_mesh(n: Optional[int] = None):
+    import jax
+
+    devs = jax.devices()
+    if n is not None:
+        devs = devs[:n]
+    return make_mesh({"data": len(devs)}, devs)
+
+
+def shard(x, mesh, spec):
+    """Place ``x`` on ``mesh`` with PartitionSpec ``spec`` (tuple of axis
+    names / None, or an existing PartitionSpec)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if not isinstance(spec, PartitionSpec):
+        spec = PartitionSpec(*spec)
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def replicate(x, mesh):
+    from jax.sharding import PartitionSpec
+
+    return shard(x, mesh, PartitionSpec())
+
+
+_local = threading.local()
+
+
+def current_mesh():
+    """The ambient mesh installed by ``set_current_mesh`` (None if unset)."""
+    return getattr(_local, "mesh", None)
+
+
+class set_current_mesh:
+    """Context manager installing an ambient mesh, so higher layers
+    (executor sharding, kvstore facade) can pick it up without plumbing."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_local, "mesh", None)
+        _local.mesh = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _local.mesh = self._prev
+        return False

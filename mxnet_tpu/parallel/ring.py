@@ -1,0 +1,155 @@
+"""Sequence-parallel attention over the device mesh — the long-context
+engine (new capability vs the reference, which only had bucketing for long
+sequences; SURVEY.md §5.7).
+
+Two schemes, both exact (not approximations of softmax attention):
+
+* ``ring_attention`` — K/V blocks rotate around the mesh ring with
+  ``lax.ppermute`` while each device's Q block accumulates the softmax
+  online (the numerically-stable m/l running max/denominator recurrence).
+  Communication overlaps compute; memory per device is O(seq/n).
+* ``ulysses_attention`` — ``lax.all_to_all`` reshards from sequence-sharded
+  to head-sharded, runs dense local attention, then reshards back. Cheaper
+  at moderate sequence lengths when heads >= mesh axis size.
+
+Tensor convention: [batch, seq, heads, head_dim], sequence sharded on
+``axis`` (default 'seq').
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+_NEG = -1e30
+
+
+def local_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Dense single-device softmax attention — the oracle and the inner
+    kernel for ulysses. [b, s, h, d] in/out."""
+    import jax.numpy as jnp
+
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jnp.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
+
+
+def _ring_inner(q, k, v, *, axis, vary_axes, n_shards, causal, scale):
+    import jax.numpy as jnp
+    from jax import lax
+
+    idx = lax.axis_index(axis)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    q_pos = idx * sq + jnp.arange(sq)
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    # initial accumulators must carry the same varying-axis type as the
+    # loop outputs (shard_map VMA typing)
+    def _vary(x):
+        return lax.pcast(x, vary_axes, to="varying")
+
+    o0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32))
+    m0 = _vary(jnp.full((b, h, sq), _NEG, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, sq), jnp.float32))
+
+    def step(carry, t):
+        o, m, l, k_blk, v_blk = carry
+        # after t right-rotations this device holds block (idx - t) mod n
+        k_idx = jnp.mod(idx - t, n_shards)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32)
+        s = s * scale
+        if causal:
+            k_pos = k_idx * sk + jnp.arange(sk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)  # [b, h, q]
+        l = l * corr + p.sum(-1)
+        o = (o * corr.transpose(0, 2, 1)[..., None] +
+             jnp.einsum("bhqk,bkhd->bqhd", p,
+                        v_blk.astype(jnp.float32)))
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return (o, m_new, l, k_blk, v_blk), None
+
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(n_shards))
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis: str = "seq",
+                   batch_axis: Optional[str] = None, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Exact attention with the sequence dimension sharded over ``axis`` of
+    ``mesh``; K/V ride the ring via ppermute (ICI neighbours on TPU).
+
+    q, k, v: [batch, seq, heads, head_dim] global arrays (sequence may be
+    sharded on ``axis``; batch optionally on ``batch_axis``)."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    n_shards = mesh.shape[axis]
+    spec = P(batch_axis, axis, None, None)
+    vary_axes = (axis,) + ((batch_axis,) if batch_axis else ())
+    inner = functools.partial(_ring_inner, axis=axis, vary_axes=vary_axes,
+                              n_shards=n_shards, causal=causal, scale=scale)
+    fn = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec)
+    return fn(q, k, v)
+
+
+def _ulysses_inner(q, k, v, *, axis, n_shards, causal, scale, attn_fn):
+    from jax import lax
+
+    # [b, s/n, h, d] -> [b, s, h/n, d]: gather sequence, scatter heads
+    def fwd(x):
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def bwd(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    out = attn_fn(fwd(q), fwd(k), fwd(v), causal=causal, scale=scale)
+    return bwd(out)
+
+
+def ulysses_attention(q, k, v, mesh, axis: str = "seq",
+                      batch_axis: Optional[str] = None, causal: bool = False,
+                      scale: Optional[float] = None, attn_fn=None):
+    """All-to-all sequence parallelism: heads are sharded during attention,
+    sequence is sharded elsewhere. Requires heads % mesh.shape[axis] == 0."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_shards = mesh.shape[axis]
+    if q.shape[2] % n_shards:
+        raise ValueError(
+            "ulysses needs heads (%d) divisible by mesh axis %r size %d"
+            % (q.shape[2], axis, n_shards))
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    if attn_fn is None:
+        attn_fn = local_attention
+    spec = P(batch_axis, axis, None, None)
+    inner = functools.partial(_ulysses_inner, axis=axis, n_shards=n_shards,
+                              causal=causal, scale=scale, attn_fn=attn_fn)
+    fn = shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec)
+    return fn(q, k, v)

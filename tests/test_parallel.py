@@ -1,0 +1,119 @@
+"""Sequence/pipeline parallelism over the 8-virtual-device CPU mesh — exact
+against single-device oracles (the reference has no such capability; these
+are the new first-class components of SURVEY.md §7 step 8)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _qkv(b=2, s=32, h=4, d=8, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    q = rng.randn(b, s, h, d).astype(dtype)
+    k = rng.randn(b, s, h, d).astype(dtype)
+    v = rng.randn(b, s, h, d).astype(dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_local(causal):
+    import jax.numpy as jnp
+
+    q, k, v = _qkv()
+    mesh = parallel.make_mesh({"seq": 8})
+    ref = parallel.local_attention(jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v), causal=causal)
+    out = parallel.ring_attention(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), mesh, causal=causal)
+    assert_almost_equal(np.asarray(out), np.asarray(ref),
+                        rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_local(causal):
+    import jax.numpy as jnp
+
+    q, k, v = _qkv(h=8)
+    mesh = parallel.make_mesh({"seq": 8})
+    ref = parallel.local_attention(jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v), causal=causal)
+    out = parallel.ulysses_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), mesh, causal=causal)
+    assert_almost_equal(np.asarray(out), np.asarray(ref),
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_2d_mesh_batch_sharded():
+    """dp x sp: batch on 'data', sequence on 'seq'."""
+    import jax.numpy as jnp
+
+    q, k, v = _qkv(b=4, s=16)
+    mesh = parallel.make_mesh({"data": 2, "seq": 4})
+    ref = parallel.local_attention(jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v), causal=True)
+    out = parallel.ring_attention(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), mesh, axis="seq",
+                                  batch_axis="data", causal=True)
+    assert_almost_equal(np.asarray(out), np.asarray(ref),
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_grads_match():
+    import jax
+    import jax.numpy as jnp
+
+    q, k, v = _qkv(s=16)
+    mesh = parallel.make_mesh({"seq": 8})
+
+    def loss_ring(q, k, v):
+        return parallel.ring_attention(q, k, v, mesh, causal=True).sum()
+
+    def loss_ref(q, k, v):
+        return parallel.local_attention(q, k, v, causal=True).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b in zip(g_ring, g_ref):
+        assert_almost_equal(np.asarray(a), np.asarray(b),
+                            rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_spmd_matches_sequential():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    n_stages, d, batch = 4, 6, 8
+    ws = rng.randn(n_stages, d, d).astype(np.float32) * 0.3
+    x = rng.randn(batch, d).astype(np.float32)
+
+    def stage_fn(w, a):
+        return jnp.tanh(a @ w)
+
+    import jax
+
+    mesh = parallel.make_mesh({"pipe": 4}, devices=jax.devices()[:4])
+    out = parallel.pipeline_spmd(stage_fn, jnp.asarray(ws), jnp.asarray(x),
+                                 mesh, axis="pipe", n_microbatches=4)
+    ref = x
+    for i in range(n_stages):
+        ref = np.tanh(ref @ ws[i])
+    assert_almost_equal(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_mesh_config_infer():
+    mesh = parallel.make_mesh({"data": -1, "model": 2})
+    assert mesh.shape["model"] == 2
+    assert mesh.shape["data"] * 2 == len(mesh.devices.ravel())
+
+
+def test_current_mesh_scope():
+    mesh = parallel.data_parallel_mesh()
+    assert parallel.current_mesh() is None
+    with parallel.set_current_mesh(mesh):
+        assert parallel.current_mesh() is mesh
+    assert parallel.current_mesh() is None
