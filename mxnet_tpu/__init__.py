@@ -40,3 +40,4 @@ from . import monitor as mon
 from . import visualization
 from . import visualization as viz
 from . import profiler
+from . import image

@@ -1,0 +1,561 @@
+"""mx.image — pure-Python image pipeline (reference:
+python/mxnet/image.py, 491 LoC, backed there by the OpenCV imperative ops
+``_cvimdecode``/``_cvimresize`` from src/io/image_io.cc:269-291).
+
+TPU-native layout decision: decode/augment run on host CPU over numpy HWC
+uint8/float32 (PIL backend, image_backend.py); the device only ever sees the
+batched, normalized NCHW tensor — keeping host→HBM transfers to one
+contiguous buffer per batch.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random as pyrandom
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from . import image_backend, io as mxio, ndarray as nd, recordio
+
+__all__ = [
+    "imdecode", "imresize", "scale_down", "resize_short", "fixed_crop",
+    "random_crop", "center_crop", "random_size_crop", "color_normalize",
+    "ResizeAug", "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug",
+    "CenterCropAug", "RandomOrderAug", "BrightnessJitterAug",
+    "ContrastJitterAug", "SaturationJitterAug", "LightingAug",
+    "ColorNormalizeAug", "HorizontalFlipAug", "CastAug", "CreateAugmenter",
+    "ImageIter", "ImageRecordIter",
+]
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decode an image buffer to an HWC uint8 NDArray (reference
+    image.py imdecode → _cvimdecode)."""
+    arr = image_backend.decode_image(buf, channels=3 if flag else 1)
+    if not to_rgb:
+        arr = arr[:, :, ::-1]
+    return nd.array(arr, dtype=np.uint8)
+
+
+def imresize(src, w, h, interp=1):
+    arr = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    out = image_backend.resize_image(arr, w, h, interp)
+    return nd.array(out, dtype=out.dtype)
+
+
+def _as_np(img):
+    return img.asnumpy() if isinstance(img, nd.NDArray) else np.asarray(img)
+
+
+def scale_down(src_size, size):
+    """Scale (w, h) down to fit in src_size, preserving aspect."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+def resize_short(src, size, interp=1):
+    """Resize so the shorter edge equals ``size``."""
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    out = image_backend.resize_image(arr, new_w, new_h, interp)
+    return nd.array(out, dtype=out.dtype)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    arr = _as_np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        arr = image_backend.resize_image(arr, size[0], size[1], interp)
+    return nd.array(arr, dtype=arr.dtype)
+
+
+def random_crop(src, size, interp=1):
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=1):
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, min_area=0.08, ratio=(3.0 / 4.0, 4.0 / 3.0),
+                     interp=1):
+    """Random crop with area and aspect-ratio jitter (Inception-style)."""
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = pyrandom.uniform(min_area, 1.0) * area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+                (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    arr = _as_np(src).astype(np.float32)
+    arr = arr - np.asarray(mean, np.float32)
+    if std is not None:
+        arr = arr / np.asarray(std, np.float32)
+    return nd.array(arr, dtype=np.float32)
+
+
+# -- augmenter callables (reference image.py returns lists of closures) -----
+
+def ResizeAug(size, interp=1):
+    def aug(src):
+        return [resize_short(src, size, interp)]
+    return aug
+
+
+def ForceResizeAug(size, interp=1):
+    def aug(src):
+        arr = _as_np(src)
+        return [nd.array(image_backend.resize_image(
+            arr.astype(np.uint8), size[0], size[1], interp))]
+    return aug
+
+
+def RandomCropAug(size, interp=1):
+    def aug(src):
+        return [random_crop(src, size, interp)[0]]
+    return aug
+
+
+def RandomSizedCropAug(size, min_area=0.08, ratio=(3 / 4, 4 / 3), interp=1):
+    def aug(src):
+        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
+    return aug
+
+
+def CenterCropAug(size, interp=1):
+    def aug(src):
+        return [center_crop(src, size, interp)[0]]
+    return aug
+
+
+def RandomOrderAug(ts):
+    def aug(src):
+        srcs = [src]
+        ts_shuffled = list(ts)
+        pyrandom.shuffle(ts_shuffled)
+        for t in ts_shuffled:
+            srcs = [j for i in srcs for j in t(i)]
+        return srcs
+    return aug
+
+
+def BrightnessJitterAug(brightness):
+    def aug(src):
+        alpha = 1.0 + pyrandom.uniform(-brightness, brightness)
+        return [nd.array(_as_np(src).astype(np.float32) * alpha)]
+    return aug
+
+
+def ContrastJitterAug(contrast):
+    coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def aug(src):
+        alpha = 1.0 + pyrandom.uniform(-contrast, contrast)
+        arr = _as_np(src).astype(np.float32)
+        gray = (arr * coef).sum() * (3.0 / arr.size) * (1.0 - alpha)
+        return [nd.array(arr * alpha + gray)]
+    return aug
+
+
+def SaturationJitterAug(saturation):
+    coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def aug(src):
+        alpha = 1.0 + pyrandom.uniform(-saturation, saturation)
+        arr = _as_np(src).astype(np.float32)
+        gray = (arr * coef).sum(axis=2, keepdims=True) * (1.0 - alpha)
+        return [nd.array(arr * alpha + gray)]
+    return aug
+
+
+def LightingAug(alphastd, eigval, eigvec):
+    """AlexNet-style PCA color noise."""
+    def aug(src):
+        alpha = np.random.normal(0, alphastd, size=(3,))
+        rgb = np.dot(np.asarray(eigvec) * alpha, np.asarray(eigval))
+        return [nd.array(_as_np(src).astype(np.float32) + rgb)]
+    return aug
+
+
+def ColorNormalizeAug(mean, std):
+    def aug(src):
+        return [color_normalize(src, mean, std)]
+    return aug
+
+
+def HorizontalFlipAug(p):
+    def aug(src):
+        if pyrandom.random() < p:
+            return [nd.array(_as_np(src)[:, ::-1].copy())]
+        return [src]
+    return aug
+
+
+def CastAug():
+    def aug(src):
+        return [nd.array(_as_np(src).astype(np.float32))]
+    return aug
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Standard augmenter chain (reference image.py CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        ts = []
+        if brightness:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation:
+            ts.append(SaturationJitterAug(saturation))
+        auglist.append(RandomOrderAug(ts))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(mxio.DataIter):
+    """Image iterator with pluggable augmenters, reading RecordIO packs
+    (``path_imgrec``) or an image list + root dir (``path_imglist`` /
+    ``imglist``). Reference: python/mxnet/image.py ImageIter; rank sharding
+    via part_index/num_parts matches the reference's kv.rank split
+    (src/io/iter_image_recordio.cc InputSplit usage)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 last_batch_handle="pad", **kwargs):
+        super(ImageIter, self).__init__()
+        assert path_imgrec or path_imglist or imglist is not None, \
+            "must supply path_imgrec, path_imglist or imglist"
+        assert len(data_shape) == 3 and data_shape[0] == 3
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.data_name = data_name
+        self.label_name = label_name
+        self.record = None
+        self.imglist = None
+        if path_imgrec:
+            idx_path = kwargs.get("path_imgidx",
+                                  os.path.splitext(path_imgrec)[0] + ".idx")
+            if os.path.exists(idx_path):
+                self.record = recordio.MXIndexedRecordIO(idx_path,
+                                                         path_imgrec, "r")
+                self.seq = list(self.record.keys)
+            else:
+                self.record = recordio.MXRecordIO(path_imgrec, "r")
+                if shuffle or num_parts > 1:
+                    # no sidecar .idx: build an in-memory offset index with
+                    # one sequential scan so shuffle/sharding still work
+                    # (the C++ reference shuffles chunk-wise without one)
+                    self._offsets = []
+                    while True:
+                        pos = self.record.tell()
+                        if self.record.read() is None:
+                            break
+                        self._offsets.append(pos)
+                    self.record.reset()
+                    self.seq = list(range(len(self._offsets)))
+                else:
+                    self.seq = None
+        else:
+            if path_imglist:
+                entries = []
+                with open(path_imglist) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        label = np.array(parts[1:-1], np.float32)
+                        entries.append((parts[-1], label))
+                self.imglist = entries
+            else:
+                self.imglist = [
+                    (item[-1], np.array(item[:-1], np.float32).reshape(-1))
+                    if not isinstance(item, str) else (item, np.zeros(1))
+                    for item in imglist]
+            self.path_root = path_root or "."
+            self.seq = list(range(len(self.imglist)))
+        if self.seq is not None and num_parts > 1:
+            # rank sharding: contiguous split like dmlc InputSplit, with the
+            # remainder spread over the first parts (no sample dropped)
+            n, rem = divmod(len(self.seq), num_parts)
+            start = part_index * n + min(part_index, rem)
+            stop = start + n + (1 if part_index < rem else 0)
+            self.seq = self.seq[start:stop]
+        self.shuffle = shuffle
+        if last_batch_handle not in ("pad", "discard"):
+            raise ValueError("last_batch_handle must be 'pad' or 'discard', "
+                             "got %r" % (last_batch_handle,))
+        self.last_batch_handle = last_batch_handle
+        if aug_list is None:
+            aug_list = CreateAugmenter(data_shape)
+        self.auglist = aug_list
+        self.cur = 0
+        self._provide_data = [mxio.DataDesc(data_name,
+                                            (batch_size,) + self.data_shape)]
+        label_shape = (batch_size,) if label_width == 1 else \
+            (batch_size, label_width)
+        self._provide_label = [mxio.DataDesc(label_name, label_shape)]
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.record is not None and self.seq is None:
+            self.record.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """One (label, encoded image bytes) pair — decode is deferred so
+        subclasses can parallelize it (the reference's OMP decode threads,
+        iter_image_recordio.cc:140-160)."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.record is not None:
+                if getattr(self, "_offsets", None) is not None:
+                    self.record.seek(self._offsets[idx])
+                    s = self.record.read()
+                else:
+                    s = self.record.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, img
+            fname, label = self.imglist[idx]
+            with open(os.path.join(self.path_root, fname), "rb") as fin:
+                img = fin.read()
+            return label, img
+        s = self.record.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def _decode_augment(self, buf):
+        """Decode one sample and run the augmenter chain → HWC float32."""
+        arr = nd.array(image_backend.decode_image(buf))
+        for aug in self.auglist:
+            arr = aug(arr)[0]
+        return _as_np(arr).astype(np.float32)
+
+    def _collect_raw(self):
+        """Read up to batch_size raw samples; StopIteration if exhausted."""
+        samples = []
+        try:
+            while len(samples) < self.batch_size:
+                samples.append(self.next_sample())
+        except StopIteration:
+            if not samples:
+                raise
+        return samples
+
+    def _decode_batch(self, samples):
+        return [self._decode_augment(buf) for _, buf in samples]
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, h, w, c), np.float32)
+        batch_label = np.zeros((self.batch_size, self.label_width), np.float32)
+        samples = self._collect_raw()
+        decoded = self._decode_batch(samples)
+        i = 0
+        for (label, _), data in zip(samples, decoded):
+            if data.shape[:2] != (h, w):
+                if not getattr(self, "_warned_shape", False):
+                    logging.warning(
+                        "ImageIter: dropping sample with post-augment shape "
+                        "%s != %s — add a crop/ForceResize augmenter",
+                        data.shape, (h, w))
+                    self._warned_shape = True
+                continue
+            batch_data[i] = data
+            lab = np.asarray(label, np.float32).reshape(-1)
+            batch_label[i] = lab[:self.label_width]
+            i += 1
+        if i == 0 or (i < self.batch_size and
+                      self.last_batch_handle == "discard"):
+            raise StopIteration
+        # pad the final partial batch by repeating the last sample
+        for j in range(i, self.batch_size):
+            batch_data[j] = batch_data[i - 1]
+            batch_label[j] = batch_label[i - 1]
+        data_nchw = np.transpose(batch_data, (0, 3, 1, 2))
+        label_out = batch_label[:, 0] if self.label_width == 1 else batch_label
+        return mxio.DataBatch(data=[nd.array(data_nchw)],
+                              label=[nd.array(label_out)],
+                              pad=self.batch_size - i)
+
+
+class _ParallelImageIter(ImageIter):
+    """ImageIter with a thread pool decoding/augmenting each batch — the
+    TPU-side analogue of the reference's preprocess_threads OMP pool."""
+
+    def __init__(self, *args, preprocess_threads=4, **kwargs):
+        from concurrent.futures import ThreadPoolExecutor
+
+        super(_ParallelImageIter, self).__init__(*args, **kwargs)
+        self._pool = ThreadPoolExecutor(max_workers=max(1, preprocess_threads))
+
+    def _decode_batch(self, samples):
+        return list(self._pool.map(self._decode_augment,
+                                   [buf for _, buf in samples]))
+
+
+def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
+                    shuffle=False, rand_crop=False, rand_mirror=False,
+                    mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                    std_r=1.0, std_g=1.0, std_b=1.0, resize=0,
+                    part_index=0, num_parts=1, preprocess_threads=4,
+                    prefetch_buffer=1, data_name="data",
+                    label_name="softmax_label", **kwargs):
+    """RecordIO image iterator: threaded decode + augment + prefetch + rank
+    sharding (reference: the C++ ImageRecordIter chain
+    parser→augmenter→normalize→batch→prefetch, src/io/io.cc:9-23). Returns
+    a DataIter yielding NCHW float32 batches."""
+    mean = None
+    std = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b], np.float32)
+    if (std_r, std_g, std_b) != (1.0, 1.0, 1.0):
+        std = np.array([std_r, std_g, std_b], np.float32)
+    aug_list = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
+                               rand_mirror=rand_mirror, mean=mean, std=std)
+    inner = _ParallelImageIter(
+        batch_size, data_shape, label_width=label_width,
+        path_imgrec=path_imgrec, shuffle=shuffle, part_index=part_index,
+        num_parts=num_parts, aug_list=aug_list, data_name=data_name,
+        label_name=label_name, preprocess_threads=preprocess_threads,
+        **kwargs)
+    if prefetch_buffer:
+        return mxio.PrefetchingIter(inner)
+    return inner
+
+
+# -- imperative decode/resize ops (reference src/io/image_io.cc:269-291:
+# _cvimdecode/_cvimresize/_cvcopyMakeBorder backing mx.image) — host-side,
+# eager-only: output shapes are data-dependent so they cannot trace under jit
+def _register_image_ops():
+    from .ops.param import Param
+    from .ops.registry import register as reg_op
+
+    @reg_op("_cvimdecode", inputs=("buf",),
+            params={"flag": Param(int, default=1),
+                    "to_rgb": Param(bool, default=True)},
+            hint="cvimdecode")
+    def _cvimdecode(opctx, attrs, buf):
+        import jax.numpy as jnp
+
+        arr = image_backend.decode_image(
+            np.asarray(buf).tobytes(), channels=3 if attrs["flag"] else 1)
+        if not attrs["to_rgb"]:
+            arr = arr[:, :, ::-1]
+        return jnp.asarray(arr)
+
+    @reg_op("_cvimresize", inputs=("data",),
+            params={"w": Param(int, required=True),
+                    "h": Param(int, required=True),
+                    "interp": Param(int, default=1)},
+            infer_shape=lambda attrs, s: (
+                s, [(attrs["h"], attrs["w"], s[0][2])] if s[0] else [None], []),
+            hint="cvimresize")
+    def _cvimresize(opctx, attrs, data):
+        import jax.numpy as jnp
+
+        arr = image_backend.resize_image(
+            np.asarray(data).astype(np.uint8), attrs["w"], attrs["h"],
+            attrs["interp"])
+        return jnp.asarray(arr)
+
+    @reg_op("_cvcopyMakeBorder", inputs=("data",),
+            params={"top": Param(int, required=True),
+                    "bot": Param(int, required=True),
+                    "left": Param(int, required=True),
+                    "right": Param(int, required=True),
+                    "type": Param(int, default=0),
+                    "values": Param("float-shape", default=(0.0,))},
+            hint="cvcopymakeborder")
+    def _cvcopyMakeBorder(opctx, attrs, data):
+        import jax.numpy as jnp
+
+        arr = np.asarray(data)
+        val = attrs["values"][0] if attrs["values"] else 0.0
+        out = np.pad(arr, ((attrs["top"], attrs["bot"]),
+                           (attrs["left"], attrs["right"]), (0, 0)),
+                     constant_values=val)
+        return jnp.asarray(out)
+
+
+_register_image_ops()
+
+# refresh the generated op surfaces (codegen ran before these ops existed)
+from . import symbol as _sym_mod  # noqa: E402
+
+nd._init_ops()
+_sym_mod._init_symbol_module()

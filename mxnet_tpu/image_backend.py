@@ -55,12 +55,21 @@ def encode_image(arr: np.ndarray, img_fmt: str = ".jpg", quality: int = 95) -> b
 
 
 def resize_image(arr: np.ndarray, w: int, h: int, interp: int = 1) -> np.ndarray:
+    """Resize HWC preserving dtype: uint8 goes through PIL directly; float
+    images are resized per-channel in 'F' mode (PIL has no float RGB mode) —
+    no wrapping casts."""
     if not HAVE_PIL:
         raise RuntimeError("No image resize backend available (PIL missing)")
     interp_map = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
                   3: Image.NEAREST, 4: Image.LANCZOS}
+    mode = interp_map.get(interp, Image.BILINEAR)
+    if np.issubdtype(arr.dtype, np.floating):
+        chans = [np.asarray(Image.fromarray(
+            arr[:, :, c].astype(np.float32), mode="F").resize((w, h), mode))
+            for c in range(arr.shape[-1])]
+        return np.stack(chans, axis=-1).astype(arr.dtype, copy=False)
     img = Image.fromarray(arr.squeeze() if arr.shape[-1] == 1 else arr)
-    img = img.resize((w, h), interp_map.get(interp, Image.BILINEAR))
+    img = img.resize((w, h), mode)
     out = np.asarray(img)
     if out.ndim == 2:
         out = out[:, :, None]

@@ -468,3 +468,12 @@ class CSVIter(DataIter):
     def getpad(self):
         end = self.cursor + self.batch_size
         return max(0, end - self.num_data)
+
+
+def ImageRecordIter(**kwargs):
+    """RecordIO image iterator (reference: C++ ImageRecordIter registered in
+    src/io/io.cc:9-23, exposed as mx.io.ImageRecordIter). Delegates to the
+    Python pipeline in mxnet_tpu.image."""
+    from . import image
+
+    return image.ImageRecordIter(**kwargs)

@@ -1,0 +1,210 @@
+"""Image pipeline tests: mx.image functions/augmenters, ImageIter,
+ImageRecordIter, and the im2rec packer round-trip (reference:
+tests/python/unittest/test_io.py + test_recordio.py + image.py usage)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, image_backend, nd, recordio
+
+pytestmark = pytest.mark.skipif(not image_backend.HAVE_PIL,
+                                reason="PIL not available")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+IM2REC = os.path.join(REPO_ROOT, "tools", "im2rec.py")
+
+
+def _im2rec(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.check_call([sys.executable, IM2REC] + list(args),
+                          cwd=REPO_ROOT, env=env)
+
+
+def _make_img(h, w, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+
+
+def _make_dataset(tmp_path, n=12, size=32):
+    """Write n PNGs in two class subdirs; return root."""
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        os.makedirs(root / cls, exist_ok=True)
+    for i in range(n):
+        cls = "cat" if i % 2 == 0 else "dog"
+        buf = image_backend.encode_image(_make_img(size, size, seed=i),
+                                         ".png")
+        with open(root / cls / ("im%03d.png" % i), "wb") as f:
+            f.write(buf)
+    return str(root)
+
+
+def test_imdecode_imresize_round_trip():
+    img = _make_img(24, 16)
+    buf = image_backend.encode_image(img, ".png")
+    dec = image.imdecode(buf)
+    assert dec.shape == (24, 16, 3)
+    assert np.array_equal(dec.asnumpy(), img)  # png is lossless
+    r = image.imresize(dec, 8, 12)
+    assert r.shape == (12, 8, 3)
+
+
+def test_cv_ops_imperative():
+    img = _make_img(10, 10)
+    buf = np.frombuffer(image_backend.encode_image(img, ".png"), np.uint8)
+    dec = nd._cvimdecode(nd.array(buf, dtype=np.uint8))
+    assert dec.shape == (10, 10, 3)
+    res = nd._cvimresize(dec, w=5, h=7)
+    assert res.shape == (7, 5, 3)
+    pad = nd._cvcopyMakeBorder(dec, top=1, bot=2, left=3, right=4)
+    assert pad.shape == (13, 17, 3)
+
+
+def test_crops_and_normalize():
+    img = nd.array(_make_img(40, 30))
+    c, _ = image.center_crop(img, (20, 20))
+    assert c.shape == (20, 20, 3)
+    r, (x0, y0, w, h) = image.random_crop(img, (16, 16))
+    assert r.shape == (16, 16, 3) and w == 16 and h == 16
+    s = image.resize_short(img, 24)
+    assert min(s.shape[:2]) == 24
+    norm = image.color_normalize(nd.array(_make_img(4, 4).astype(np.float32)),
+                                 mean=np.array([1.0, 2.0, 3.0]),
+                                 std=np.array([2.0, 2.0, 2.0]))
+    assert norm.dtype == np.float32
+
+
+def test_augmenter_chain_shapes():
+    auglist = image.CreateAugmenter((3, 20, 20), resize=24, rand_crop=True,
+                                    rand_mirror=True, mean=True, std=True,
+                                    brightness=0.1, contrast=0.1,
+                                    saturation=0.1, pca_noise=0.05)
+    arr = nd.array(_make_img(40, 32))
+    for aug in auglist:
+        arr = aug(arr)[0]
+    out = arr.asnumpy()
+    assert out.shape == (20, 20, 3)
+    assert out.dtype == np.float32
+
+
+def test_im2rec_pack_and_image_iter(tmp_path):
+    root = _make_dataset(tmp_path)
+    prefix = str(tmp_path / "data")
+    _im2rec("--list", "--recursive", prefix, root)
+    assert os.path.exists(prefix + ".lst")
+    _im2rec("--recursive", prefix, root)
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    it = image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                         path_imgrec=prefix + ".rec",
+                         aug_list=image.CreateAugmenter((3, 24, 24),
+                                                        resize=28))
+    batches = list(it)
+    assert len(batches) == 3  # 12 imgs / 4
+    for b in batches:
+        assert b.data[0].shape == (4, 3, 24, 24)
+        assert b.label[0].shape == (4,)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert set(labels.astype(int)) == {0, 1}
+    # reset and re-iterate
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_image_record_iter_threaded(tmp_path):
+    root = _make_dataset(tmp_path, n=16, size=40)
+    prefix = str(tmp_path / "rec2")
+    _im2rec("--list", "--recursive", prefix, root)
+    _im2rec("--recursive", prefix, root)
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 32, 32), batch_size=8,
+                               resize=36, rand_crop=True, rand_mirror=True,
+                               mean_r=123.0, mean_g=117.0, mean_b=104.0,
+                               preprocess_threads=2, shuffle=True)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (8, 3, 32, 32)
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_image_iter_rank_sharding(tmp_path):
+    root = _make_dataset(tmp_path, n=12)
+    prefix = str(tmp_path / "shard")
+    _im2rec("--list", "--recursive", "--no-shuffle", prefix, root)
+    _im2rec("--recursive", prefix, root)
+    seen = []
+    for part in range(3):
+        it = image.ImageIter(batch_size=2, data_shape=(3, 24, 24),
+                             path_imgrec=prefix + ".rec", part_index=part,
+                             num_parts=3,
+                             aug_list=image.CreateAugmenter((3, 24, 24),
+                                                            resize=28))
+        n = sum(b.data[0].shape[0] - (b.pad or 0) for b in it)
+        seen.append(n)
+    assert sum(seen) == 12
+    assert all(s == 4 for s in seen)
+
+
+def test_float_resize_preserves_dtype():
+    arr = np.random.uniform(-300, 300, (8, 8, 3)).astype(np.float32)
+    out = image_backend.resize_image(arr, 4, 4)
+    assert out.dtype == np.float32
+    # no modulo-256 wrapping: negatives survive and values stay in range
+    assert out.min() < 0
+    assert arr.min() - 1 <= out.min() and out.max() <= arr.max() + 1
+
+
+def test_rank_sharding_remainder(tmp_path):
+    root = _make_dataset(tmp_path, n=14)
+    prefix = str(tmp_path / "rem")
+    _im2rec("--list", "--recursive", "--no-shuffle", prefix, root)
+    _im2rec("--recursive", prefix, root)
+    seen = []
+    for part in range(4):
+        it = image.ImageIter(batch_size=1, data_shape=(3, 24, 24),
+                             path_imgrec=prefix + ".rec", part_index=part,
+                             num_parts=4,
+                             aug_list=image.CreateAugmenter((3, 24, 24),
+                                                            resize=28))
+        seen.append(sum(1 for _ in it))
+    assert sum(seen) == 14  # remainder samples are not dropped
+    assert sorted(seen) == [3, 3, 4, 4]
+
+
+def test_no_idx_shuffle_and_shard(tmp_path):
+    """Without a .idx sidecar, shuffle and sharding must still work (offset
+    index built by one sequential scan)."""
+    root = _make_dataset(tmp_path, n=12)
+    prefix = str(tmp_path / "noidx")
+    _im2rec("--list", "--recursive", "--no-shuffle", prefix, root)
+    _im2rec("--recursive", prefix, root)
+    os.remove(prefix + ".idx")
+    seen = []
+    for part in range(2):
+        it = image.ImageIter(batch_size=2, data_shape=(3, 24, 24),
+                             path_imgrec=prefix + ".rec", shuffle=True,
+                             part_index=part, num_parts=2,
+                             aug_list=image.CreateAugmenter((3, 24, 24),
+                                                            resize=28))
+        seen.append(sum(b.data[0].shape[0] - (b.pad or 0) for b in it))
+    assert seen == [6, 6]
+
+
+def test_last_batch_discard(tmp_path):
+    root = _make_dataset(tmp_path, n=10)
+    prefix = str(tmp_path / "disc")
+    _im2rec("--list", "--recursive", prefix, root)
+    _im2rec("--recursive", prefix, root)
+    it = image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                         path_imgrec=prefix + ".rec",
+                         last_batch_handle="discard",
+                         aug_list=image.CreateAugmenter((3, 24, 24),
+                                                        resize=28))
+    assert len(list(it)) == 2  # 10 // 4, partial batch discarded

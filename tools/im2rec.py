@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""im2rec — pack an image directory / list file into RecordIO
+(reference: /root/reference/tools/im2rec.py and tools/im2rec.cc; same .lst
+tab format ``index\\tlabel[...]\\trelpath`` and .rec/.idx output, so packs
+are interchangeable with the reference's).
+
+Usage:
+  python tools/im2rec.py --list prefix root     # generate prefix.lst
+  python tools/im2rec.py prefix root            # pack prefix.lst -> .rec/.idx
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu import recordio  # noqa: E402
+from mxnet_tpu import image_backend  # noqa: E402
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive=False):
+    i = 0
+    if recursive:
+        cat = {}
+        for path, _, files in sorted(os.walk(root, followlinks=True)):
+            for fname in sorted(files):
+                if os.path.splitext(fname)[1].lower() in EXTS:
+                    fpath = os.path.join(path, fname)
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            if os.path.splitext(fname)[1].lower() in EXTS:
+                yield (i, fname, 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for idx, relpath, label in image_list:
+            fout.write("%d\t%f\t%s\n" % (idx, float(label), relpath))
+
+
+def make_list(args):
+    image_list = list(list_images(args.root, args.recursive))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    write_list(args.prefix + ".lst", image_list)
+    print("wrote %d entries to %s.lst" % (len(image_list), args.prefix))
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield (int(parts[0]), parts[-1],
+                   [float(x) for x in parts[1:-1]])
+
+
+def pack(args):
+    lst = args.prefix + ".lst"
+    if not os.path.exists(lst):
+        raise SystemExit("list file %s not found; run --list first" % lst)
+    rec = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                     args.prefix + ".rec", "w")
+    n = 0
+    for idx, relpath, labels in read_list(lst):
+        fpath = os.path.join(args.root, relpath)
+        with open(fpath, "rb") as fin:
+            buf = fin.read()
+        if args.resize or args.center_crop or not args.pass_through:
+            img = image_backend.decode_image(buf)
+            if args.resize:
+                h, w = img.shape[:2]
+                if h > w:
+                    nw, nh = args.resize, int(h * args.resize / w)
+                else:
+                    nw, nh = int(w * args.resize / h), args.resize
+                img = image_backend.resize_image(img, nw, nh)
+            if args.center_crop:
+                h, w = img.shape[:2]
+                s = min(h, w)
+                y0, x0 = (h - s) // 2, (w - s) // 2
+                img = img[y0:y0 + s, x0:x0 + s]
+            buf = image_backend.encode_image(img, args.encoding,
+                                             quality=args.quality)
+        label = labels[0] if len(labels) == 1 else labels
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, buf))
+        n += 1
+    rec.close()
+    print("packed %d images into %s.rec" % (n, args.prefix))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst instead of packing")
+    ap.add_argument("--recursive", action="store_true",
+                    help="recurse into subdirs; one label per subdir")
+    ap.add_argument("--shuffle", action="store_true", default=True)
+    ap.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter edge to this")
+    ap.add_argument("--center-crop", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    ap.add_argument("--pass-through", action="store_true",
+                    help="pack raw bytes without re-encoding")
+    args = ap.parse_args()
+    if args.list:
+        make_list(args)
+    else:
+        pack(args)
+
+
+if __name__ == "__main__":
+    main()
