@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Inference throughput benchmark (reference:
+example/image-classification/benchmark_score.py): forward-only img/s for
+the model zoo across batch sizes."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def score(network, batch_size, image_shape, num_classes, num_batches=20):
+    if network.startswith("resnet"):
+        num_layers = int(network.split("-")[1]) if "-" in network else 50
+        sym = mx.models.get_resnet(num_classes=num_classes,
+                                   num_layers=num_layers,
+                                   image_shape=image_shape)
+    elif network == "alexnet":
+        sym = mx.models.get_alexnet(num_classes=num_classes)
+    elif network.startswith("inception"):
+        sym = mx.models.get_inception_bn(num_classes=num_classes)
+    elif network == "lenet":
+        sym = mx.models.get_lenet(num_classes=num_classes)
+    else:
+        raise ValueError(network)
+    data_shape = (batch_size,) + tuple(image_shape)
+    exe = sym.simple_bind(mx.current_context(), data=data_shape,
+                          grad_req="null")
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        arr[:] = rng.uniform(-0.05, 0.05, arr.shape).astype(np.float32)
+    exe.arg_dict["data"][:] = rng.uniform(0, 1, data_shape).astype(np.float32)
+    # warmup (compile)
+    out = exe.forward(is_train=False)[0]
+    out.wait_to_read()
+    t0 = time.time()
+    for _ in range(num_batches):
+        out = exe.forward(is_train=False)[0]
+    out.wait_to_read()
+    dt = time.time() - t0
+    return num_batches * batch_size / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--networks", default="lenet,resnet-18,alexnet")
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--batch-sizes", default="1,32")
+    args = ap.parse_args()
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    for net in args.networks.split(","):
+        ishape = (1, 28, 28) if net == "lenet" else shape
+        ncls = 10 if net == "lenet" else args.num_classes
+        for bs in (int(b) for b in args.batch_sizes.split(",")):
+            ips = score(net, bs, ishape, ncls)
+            print("network: %-12s batch: %-3d  %.1f img/s" % (net, bs, ips))
+
+
+if __name__ == "__main__":
+    main()

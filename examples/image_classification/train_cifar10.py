@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Train ResNet on CIFAR-10 RecordIO packs (reference:
+example/image-classification/train_cifar10.py). Falls back to --benchmark
+synthetic mode without --data-train."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from examples.image_classification.common import fit  # noqa: E402
+from examples.image_classification.train_imagenet import (  # noqa: E402
+    get_network, get_rec_iter)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train cifar10")
+    fit.add_fit_args(parser)
+    parser.add_argument("--data-train", type=str, default=None)
+    parser.add_argument("--data-val", type=str, default=None)
+    parser.add_argument("--data-nthreads", type=int, default=4)
+    parser.set_defaults(network="resnet-18", num_classes=10,
+                        image_shape="3,32,32", num_examples=50000,
+                        lr=0.05, lr_step_epochs="200,250", batch_size=128)
+    args = parser.parse_args()
+    if not args.data_train:
+        args.benchmark = 1
+    net = get_network(args)
+    fit.fit(args, net, get_rec_iter)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Train ResNet/Inception/AlexNet on ImageNet RecordIO packs (reference:
+example/image-classification/train_imagenet.py — BASELINE config #2).
+
+With --benchmark 1 (default when no --data-train) runs on synthetic data
+and reports img/s — the reference's fit.py:106-116 mode used for the
+headline throughput numbers (docs/how_to/perf.md:130-139)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from examples.image_classification.common import fit  # noqa: E402
+
+
+def get_rec_iter(args, kv):
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=shape,
+        batch_size=args.batch_size, shuffle=True, rand_crop=True,
+        rand_mirror=True, mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        part_index=kv.rank, num_parts=kv.num_workers,
+        preprocess_threads=args.data_nthreads)
+    val = None
+    if args.data_val:
+        val = mx.io.ImageRecordIter(
+            path_imgrec=args.data_val, data_shape=shape,
+            batch_size=args.batch_size, shuffle=False,
+            mean_r=123.68, mean_g=116.779, mean_b=103.939,
+            preprocess_threads=args.data_nthreads)
+    return train, val
+
+
+def get_network(args):
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    name = args.network
+    if name.startswith("resnet"):
+        num_layers = int(name[len("resnet-"):]) if "-" in name else 50
+        return mx.models.get_resnet(num_classes=args.num_classes,
+                                    num_layers=num_layers, image_shape=shape)
+    if name == "alexnet":
+        return mx.models.get_alexnet(num_classes=args.num_classes)
+    if name.startswith("inception"):
+        return mx.models.get_inception_bn(num_classes=args.num_classes)
+    raise ValueError("unknown network %s" % name)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train imagenet")
+    fit.add_fit_args(parser)
+    parser.add_argument("--data-train", type=str, default=None)
+    parser.add_argument("--data-val", type=str, default=None)
+    parser.add_argument("--data-nthreads", type=int, default=4)
+    parser.set_defaults(network="resnet-50", num_classes=1000,
+                        image_shape="3,224,224", num_examples=1281167,
+                        lr=0.1, lr_step_epochs="30,60,80", batch_size=32)
+    args = parser.parse_args()
+    if not args.data_train:
+        args.benchmark = 1
+    net = get_network(args)
+    fit.fit(args, net, get_rec_iter)
+
+
+if __name__ == "__main__":
+    main()

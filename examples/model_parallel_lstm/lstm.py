@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Model-parallel multi-layer LSTM (reference:
+example/model-parallel-lstm/lstm.py:142-205 — BASELINE config #5).
+
+Each LSTM layer is pinned to a device via ``group2ctx`` (the reference's
+``AttrScope(ctx_group=...)`` + PlaceDevice pass); activations cross device
+boundaries through compiled transfers (our jax.device_put = the reference's
+``_CrossDeviceCopy`` nodes). Trains a next-token model on a synthetic
+corpus; perplexity must fall.
+
+For mesh-style pipelining of homogeneous stacks see
+``mxnet_tpu.parallel.pipeline_spmd`` — the TPU-native successor to this
+placement scheme."""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from examples.rnn.lstm_bucketing import synthetic_corpus  # noqa: E402
+
+
+def build_symbol(seq_len, num_layers, num_hidden, num_embed, vocab_size):
+    """Unrolled stacked LSTM with one ctx group per layer."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    with mx.AttrScope(ctx_group="embed"):
+        hidden = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                                  output_dim=num_embed, name="embed")
+    for i in range(num_layers):
+        with mx.AttrScope(ctx_group="layer%d" % i):
+            cell = mx.rnn.LSTMCell(num_hidden=num_hidden,
+                                   prefix="lstm_l%d_" % i)
+            outputs, _ = cell.unroll(seq_len, inputs=hidden,
+                                     merge_outputs=True)
+            hidden = outputs
+    with mx.AttrScope(ctx_group="decode"):
+        pred = mx.sym.Reshape(hidden, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab_size,
+                                     name="pred")
+        label_r = mx.sym.Reshape(label, shape=(-1,))
+        sm = mx.sym.SoftmaxOutput(data=pred, label=label_r, name="softmax")
+    return sm
+
+
+def main():
+    ap = argparse.ArgumentParser(description="model-parallel lstm")
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-embed", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=16)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+    import jax
+
+    n_dev = len(jax.devices())
+    group2ctx = {"embed": mx.Context("cpu", 0), "decode":
+                 mx.Context("cpu", max(0, n_dev - 1))}
+    for i in range(args.num_layers):
+        group2ctx["layer%d" % i] = mx.Context("cpu", (i + 1) % n_dev)
+    logging.info("placement: %s", group2ctx)
+
+    vocab_size = 64
+    sents = [s[:args.seq_len] for s in synthetic_corpus(vocab_size, 800)
+             if len(s) >= args.seq_len]
+    data = np.array(sents, np.float32)
+    x, y = data[:, :-1], data[:, 1:]
+
+    net = build_symbol(args.seq_len - 1, args.num_layers, args.num_hidden,
+                       args.num_embed, vocab_size)
+    exe = net.simple_bind(
+        mx.cpu(), data=(args.batch_size, args.seq_len - 1),
+        softmax_label=(args.batch_size * (args.seq_len - 1),),
+        grad_req="write", group2ctx=group2ctx)
+    rng = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        arr[:] = rng.uniform(-0.08, 0.08, arr.shape).astype(np.float32)
+
+    n_batches = len(x) // args.batch_size
+    for epoch in range(args.num_epochs):
+        tot_nll, tot_tok = 0.0, 0
+        for b in range(n_batches):
+            sl = slice(b * args.batch_size, (b + 1) * args.batch_size)
+            exe.arg_dict["data"][:] = x[sl]
+            exe.arg_dict["softmax_label"][:] = y[sl].reshape(-1)
+            probs = exe.forward(is_train=True)[0].asnumpy()
+            exe.backward()
+            for name, grad in exe.grad_dict.items():
+                if name in ("data", "softmax_label") or grad is None:
+                    continue
+                mx.nd.sgd_update(exe.arg_dict[name], grad,
+                                 out=exe.arg_dict[name], lr=args.lr)
+            lab = y[sl].reshape(-1).astype(int)
+            picked = probs[np.arange(len(lab)), lab]
+            tot_nll -= np.log(np.maximum(picked, 1e-10)).sum()
+            tot_tok += len(lab)
+        ppl = np.exp(tot_nll / tot_tok)
+        logging.info("Epoch[%d] Train-Perplexity=%.3f", epoch, ppl)
+    print('{"metric": "final_perplexity", "value": %.3f}' % ppl)
+
+
+if __name__ == "__main__":
+    main()

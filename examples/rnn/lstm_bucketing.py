@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""LSTM language model with bucketing (reference:
+example/rnn/lstm_bucketing.py — BASELINE config #3, PTB).
+
+Reads PTB-format text from --data-dir when present; otherwise generates a
+synthetic Markov-chain corpus so the example runs without downloads.
+Perplexity must fall epoch over epoch."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    with open(fname) as f:
+        lines = f.readlines()
+    sentences = [line.split() for line in lines]
+    if vocab is None:
+        vocab = {}
+    out = []
+    for s in sentences:
+        ids = []
+        for w in s:
+            if w not in vocab:
+                vocab[w] = len(vocab) + start_label
+            ids.append(vocab[w])
+        if ids:
+            out.append(ids)
+    return out, vocab
+
+
+def synthetic_corpus(vocab_size=64, n_sentences=1500, seed=0):
+    """Markov chain with a sparse transition matrix — learnable structure."""
+    rng = np.random.RandomState(seed)
+    trans = np.zeros((vocab_size, vocab_size))
+    for i in range(vocab_size):
+        nxt = rng.choice(vocab_size, size=4, replace=False)
+        trans[i, nxt] = rng.dirichlet(np.ones(4))
+    sents = []
+    for _ in range(n_sentences):
+        length = rng.randint(8, 33)
+        s = [rng.randint(vocab_size)]
+        for _ in range(length - 1):
+            s.append(rng.choice(vocab_size, p=trans[s[-1]]))
+        sents.append(s)
+    return sents
+
+
+def main():
+    ap = argparse.ArgumentParser(description="lstm bucketing LM")
+    ap.add_argument("--data-dir", type=str, default="ptb_data")
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-embed", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--kv-store", type=str, default="local")
+    ap.add_argument("--disp-batches", type=int, default=20)
+    args = ap.parse_args()
+
+    train_path = os.path.join(args.data_dir, "ptb.train.txt")
+    if os.path.exists(train_path):
+        train_sent, vocab = tokenize_text(train_path, start_label=1)
+        val_sent, _ = tokenize_text(
+            os.path.join(args.data_dir, "ptb.valid.txt"), vocab=vocab,
+            start_label=1)
+        vocab_size = len(vocab) + 1
+    else:
+        vocab_size = 64
+        sents = synthetic_corpus(vocab_size)
+        train_sent, val_sent = sents[150:], sents[:150]
+
+    buckets = [8, 16, 24, 32]
+    train = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
+                                      buckets=buckets, invalid_label=0)
+    val = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
+                                    buckets=buckets, invalid_label=0)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab_size,
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, states = stack.unroll(seq_len, inputs=embed,
+                                       merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab_size,
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=train.default_bucket_key,
+                                 context=mx.current_context())
+    import logging
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+    mod.fit(train, eval_data=val,
+            eval_metric=mx.metric.Perplexity(ignore_label=0),
+            kvstore=args.kv_store, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.0,
+                              "wd": 1e-5},
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+            num_epoch=args.num_epochs,
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, args.disp_batches, auto_reset=False))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Train SSD on RecordIO detection packs or synthetic boxes (reference:
+example/ssd/train.py — BASELINE config #4). Without --data-train, trains on
+generated single-object images; the cls+loc loss must fall."""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def synthetic_detection(n, size=64, num_classes=2, seed=0):
+    rng = np.random.RandomState(seed)
+    data = np.zeros((n, 3, size, size), np.float32)
+    label = np.full((n, 4, 5), -1.0, np.float32)
+    for i in range(n):
+        s = rng.randint(size // 4, size // 2)
+        x0 = rng.randint(0, size - s)
+        y0 = rng.randint(0, size - s)
+        cls = rng.randint(0, num_classes)
+        data[i, cls % 3, y0:y0 + s, x0:x0 + s] = 1.0
+        label[i, 0] = [cls, x0 / size, y0 / size, (x0 + s) / size,
+                       (y0 + s) / size]
+    return data, label
+
+
+def main():
+    ap = argparse.ArgumentParser(description="train ssd")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.2)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--num-classes", type=int, default=2)
+    ap.add_argument("--num-examples", type=int, default=64)
+    ap.add_argument("--model-prefix", type=str, default=None)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+    data, label = synthetic_detection(args.num_examples,
+                                      num_classes=args.num_classes)
+    it = mx.io.NDArrayIter(data=data, label=label,
+                           batch_size=args.batch_size, label_name="label")
+    net = mx.models.get_ssd_train(num_classes=args.num_classes)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=mx.current_context())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "momentum": args.momentum})
+    first = last = None
+    for epoch in range(args.num_epochs):
+        it.reset()
+        tot, nb = 0.0, 0
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            cls_prob, loc_loss, cls_target = (
+                o.asnumpy() for o in mod.get_outputs())
+            valid = cls_target >= 0
+            idx = np.maximum(cls_target.astype(int), 0)
+            picked = np.take_along_axis(cls_prob, idx[:, None, :],
+                                        axis=1)[:, 0, :]
+            ce = -np.log(np.maximum(picked, 1e-8))[valid].mean()
+            tot += ce + loc_loss.sum() / max(valid.sum(), 1)
+            nb += 1
+            mod.backward()
+            mod.update()
+        avg = tot / nb
+        first = first if first is not None else avg
+        last = avg
+        logging.info("Epoch[%d] cls+loc loss=%.4f", epoch, avg)
+    if args.model_prefix:
+        mod.save_checkpoint(args.model_prefix, args.num_epochs)
+    print('{"metric": "ssd_loss_ratio", "value": %.4f}' % (last / first))
+
+
+if __name__ == "__main__":
+    main()

@@ -4,6 +4,19 @@ Apache MXNet 0.9 (reference: /root/reference), built on JAX/XLA.
 Import layout mirrors /root/reference/python/mxnet/__init__.py so reference
 user scripts port by changing only the import line.
 """
+import os as _os
+
+# Honour JAX_PLATFORMS even where the runtime image pins jax_platforms
+# (e.g. "axon,cpu") at a layer that ignores the env var; must run before
+# the first backend initialization.
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+
+    try:
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # backend already initialized — leave it be
+        pass
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
@@ -41,3 +54,4 @@ from . import visualization
 from . import visualization as viz
 from . import profiler
 from . import image
+from . import models

@@ -57,9 +57,10 @@ def log_train_metric(period, auto_reset=False):
 class Speedometer:
     """Log samples/sec every ``frequent`` batches (reference callback.py:89)."""
 
-    def __init__(self, batch_size, frequent=50):
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
+        self.auto_reset = auto_reset
         self.init = False
         self.tic = 0
         self.last_count = 0
@@ -76,7 +77,8 @@ class Speedometer:
                 self.last_speed = speed
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
-                    param.eval_metric.reset()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
                     for name, value in name_value:
                         logging.info(
                             "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t"

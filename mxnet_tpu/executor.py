@@ -51,8 +51,30 @@ class _GraphPlan:
         self.output_entries = [(id(node), idx) for node, idx in symbol._outputs]
         self.output_names = symbol.list_outputs()
 
+    def placement_map(self, group2ctx):
+        """Node-id → jax.Device from ``__ctx_group__`` attrs (reference:
+        nnvm PlaceDevice pass + _CrossDeviceCopy splicing,
+        src/executor/graph_executor.cc:230-320; here the cross-device copy
+        is a jax.device_put compiled into the jitted graph)."""
+        if not group2ctx:
+            return {}
+        placement = {}
+        for n in self.nodes:
+            if n.is_variable:
+                continue
+            # AttrScope stores the plain key; reference JSON may carry the
+            # C-API-mangled "__ctx_group__" form — accept both
+            group = None
+            for store in (n.attr_dict, n.attrs):
+                group = store.get("ctx_group") or store.get("__ctx_group__")
+                if group:
+                    break
+            if group and group in group2ctx:
+                placement[id(n)] = group2ctx[group].jax_device()
+        return placement
+
     def run(self, args: Dict[str, Any], aux: Dict[str, Any], rng,
-            is_train: bool, want_internals: bool = False):
+            is_train: bool, want_internals: bool = False, placement=None):
         """Execute the graph as a pure function of (args, aux, rng)."""
         import jax
 
@@ -72,6 +94,9 @@ class _GraphPlan:
             ins = [vals[(id(p), idx)] for p, idx in n.inputs]
             aux_in = tuple(aux[a] for a in n.aux_names())
             opctx = OpContext(is_train=is_train, rng=keys.get(id(n)))
+            if placement and id(n) in placement:
+                dev = placement[id(n)]
+                ins = [jax.device_put(x, dev) for x in ins]
             outs, aux_out = n.op.apply(opctx, n.attrs, ins, aux_in)
             for i, o in enumerate(outs):
                 vals[(id(n), i)] = o
@@ -167,6 +192,9 @@ class Executor:
         # NaiveEngine parity: MXNET_ENGINE_TYPE=NaiveEngine disables jit and
         # synchronizes after every call (threaded_engine.h:329-337 debugging).
         self._naive = env("MXNET_ENGINE_TYPE") == "NaiveEngine"
+        # model parallelism: ctx-group → device placement compiled into the
+        # step (group2ctx was previously accepted but silently ignored)
+        self._placement = plan.placement_map(self._group2ctx)
 
     # ------------------------------------------------------------------
     def _as_nd(self, v):
@@ -194,8 +222,11 @@ class Executor:
         if key not in self._jit_cache:
             plan = self._plan
 
+            placement = self._placement
+
             def fn(args, aux, rng):
-                return plan.run(args, aux, rng, is_train, want_internals=internals)
+                return plan.run(args, aux, rng, is_train,
+                                want_internals=internals, placement=placement)
 
             self._jit_cache[key] = fn if self._naive else jax.jit(fn)
         return self._jit_cache[key]
@@ -207,12 +238,14 @@ class Executor:
         if key not in self._jit_cache:
             plan = self._plan
             remat = bool(env("MXNET_BACKWARD_DO_MIRROR", 0, int))
+            placement = self._placement
 
             def fn(diff_args, other_args, aux, rng, out_grads, old_grads):
                 def f(d):
                     merged = dict(other_args)
                     merged.update(d)
-                    outs, new_aux = plan.run(merged, aux, rng, is_train)
+                    outs, new_aux = plan.run(merged, aux, rng, is_train,
+                                             placement=placement)
                     return tuple(outs), new_aux
 
                 f2 = jax.checkpoint(f) if remat else f

@@ -1,0 +1,81 @@
+"""SSD detection graph tests (BASELINE config #4; reference example/ssd/):
+training symbol learns on synthetic box data, detection symbol produces
+decoded NMS'd boxes."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.models import get_ssd_detect, get_ssd_train
+
+
+def _synthetic_boxes(n, size=64, seed=0):
+    """Images with one bright square on dark background; label row
+    [cls, xmin, ymin, xmax, ymax] normalized, padded with -1 rows."""
+    rng = np.random.RandomState(seed)
+    data = np.zeros((n, 3, size, size), np.float32)
+    label = np.full((n, 4, 5), -1.0, np.float32)
+    for i in range(n):
+        s = rng.randint(size // 4, size // 2)
+        x0 = rng.randint(0, size - s)
+        y0 = rng.randint(0, size - s)
+        cls = rng.randint(0, 2)
+        chan = 0 if cls == 0 else 2
+        data[i, chan, y0:y0 + s, x0:x0 + s] = 1.0
+        label[i, 0] = [cls, x0 / size, y0 / size, (x0 + s) / size,
+                       (y0 + s) / size]
+    return data, label
+
+
+def test_ssd_train_loss_falls():
+    np.random.seed(0)
+    data, label = _synthetic_boxes(32)
+    net = get_ssd_train(num_classes=2, num_filters=(8, 16, 16, 16))
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=mx.cpu())
+    it = mx.io.NDArrayIter(data=data, label=label, batch_size=8,
+                           label_name="label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.2,
+                                         "momentum": 0.9})
+    losses = []
+    for epoch in range(8):
+        it.reset()
+        tot, nb = 0.0, 0
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            outs = mod.get_outputs()
+            # outputs: [cls_prob (b,C,A), loc_loss (b,A4), cls_label (b,A)]
+            cls_prob = outs[0].asnumpy()
+            cls_target = outs[2].asnumpy()
+            valid = cls_target >= 0
+            idx = np.maximum(cls_target.astype(int), 0)
+            picked = np.take_along_axis(
+                cls_prob, idx[:, None, :], axis=1)[:, 0, :]
+            ce = -np.log(np.maximum(picked, 1e-8))[valid].mean()
+            loc = outs[1].asnumpy().sum() / max(valid.sum(), 1)
+            tot += ce + loc
+            nb += 1
+            mod.backward()
+            mod.update()
+        losses.append(tot / nb)
+    assert losses[-1] < losses[0] * 0.7, \
+        "SSD loss did not fall: %s" % losses
+
+
+def test_ssd_detect_output_format():
+    np.random.seed(0)
+    det = get_ssd_detect(num_classes=2, num_filters=(8, 16, 16, 16))
+    exe = det.simple_bind(mx.cpu(), data=(2, 3, 64, 64), grad_req="null")
+    for name, arr in exe.arg_dict.items():
+        if name == "data":
+            continue
+        arr[:] = np.random.uniform(-0.1, 0.1, arr.shape).astype(np.float32)
+    exe.arg_dict["data"][:] = np.random.uniform(0, 1, (2, 3, 64, 64))
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape[0] == 2 and out.shape[2] == 6
+    # rows are [cls_id, score, xmin, ymin, xmax, ymax]; suppressed rows -1
+    scores = out[:, :, 1]
+    assert ((scores <= 1.0) | (scores == -1)).all()
