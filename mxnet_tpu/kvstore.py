@@ -156,12 +156,116 @@ class KVStore:
             self._updater.set_states(fin.read())
 
 
+class DistAsyncKVStore(KVStore):
+    """``dist_async`` over the host-side parameter service
+    (kvstore_server.py): every push triggers the server updater immediately
+    — no worker synchronization (reference kvstore_dist_server.h:198-206
+    async branch + kvstore_dist.h worker client)."""
+
+    def __init__(self, kv_type="dist_async"):
+        import os
+
+        super().__init__(kv_type)
+        from . import kvstore_server as kvs
+
+        host = os.environ.get("DMLC_PS_ROOT_URI")
+        if host:
+            port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+            self._server = None
+        else:
+            # single-process bring-up: run the service in-process so the
+            # async path works without a launcher
+            self._server = kvs.start_server(
+                num_workers=int(os.environ.get("DMLC_NUM_WORKER", "1")))
+            host, port = self._server.addr
+        self._client = kvs.ServerClient(host, port)
+        self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        return self._num_workers
+
+    def init(self, key, value):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, v in zip(keys, vals):
+            if self._rank == 0:
+                arr = v[0].asnumpy() if isinstance(v[0], NDArray) else v[0]
+                self._client.init(k, arr)
+        self._client.barrier()
+
+    def push(self, key, value, priority=0):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            merged = vlist[0].asnumpy()
+            for v in vlist[1:]:
+                merged = merged + v.asnumpy()
+            self._client.push(k, merged, rank=self._rank)
+
+    def pull(self, key, out=None, priority=0):
+        import jax
+
+        keys, _ = _key_list(key)
+        outs = _val_list(out, len(keys))
+        for k, olist in zip(keys, outs):
+            arr = self._client.pull(k)
+            for o in olist:
+                data = nd.array(arr, dtype=o.dtype)._data
+                # preserve the destination's sharding (see KVStore.pull)
+                if getattr(o._data, "sharding", None) is not None and \
+                        data.sharding != o._data.sharding:
+                    data = jax.device_put(data, o._data.sharding)
+                o._set(data)
+
+    def close(self):
+        """Tear down the client socket and any in-process server."""
+        try:
+            self._client.close()
+        finally:
+            if self._server is not None:
+                self._server.stop()
+                self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def set_optimizer(self, optimizer):
+        """Ship the pickled optimizer to the server (reference
+        kvstore.py:232-255 _send_command_to_servers)."""
+        if self._rank == 0:
+            self._client.set_optimizer(optimizer)
+        self._client.barrier()
+
+    def _barrier(self):
+        self._client.barrier()
+
+    def _send_command_to_servers(self, head, body):
+        if head == "stop":
+            self._client.stop_server()
+
+    def save_optimizer_states(self, fname):
+        raise MXNetError("Cannot save states for distributed training")
+
+    def load_optimizer_states(self, fname):
+        raise MXNetError("Cannot load states for distributed training")
+
+
 def create(name="local") -> KVStore:
     """Create a KVStore (reference KVStore::Create, kvstore.cc:17-45).
     'local'/'device' → in-process aggregation (XLA fuses the reduce);
-    'dist_sync'/'dist_device_sync'/'dist_async' → same API over
-    jax.distributed (multi-host SPMD: sync semantics come from in-step
-    collectives, so dist_sync needs no server round-trips)."""
+    'dist_sync'/'dist_device_sync' → multi-host SPMD where sync semantics
+    come from in-step collectives (jax.distributed + global mesh), so no
+    server round-trips; 'dist_async' → the host-side parameter service
+    (kvstore_server.py), updater applied on every push."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     name = name.lower()
@@ -169,4 +273,6 @@ def create(name="local") -> KVStore:
                     "local_allreduce_device", "device", "dist_sync",
                     "dist_device_sync", "dist_async", "dist"):
         raise MXNetError("unknown KVStore type %s" % name)
+    if name == "dist_async":
+        return DistAsyncKVStore(name)
     return KVStore(name)

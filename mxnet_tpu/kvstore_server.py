@@ -1,0 +1,264 @@
+"""Host-side parameter service — the ``dist_async`` control/data plane.
+
+TPU-native stance (SURVEY.md §2.4, §5.8): synchronous data parallelism needs
+no server — gradients are ``psum``'d inside the jitted step over ICI. What a
+server still buys is the reference's *asynchronous* PS semantics
+(/root/reference/src/kvstore/kvstore_dist_server.h:87-260: updater runs on
+every push immediately, workers never wait for each other) plus the
+coordination plane (barriers, optimizer shipping, cooperative stop —
+kSyncMode/kStopServer commands, kvstore_dist_server.h:121-134). This module
+provides both over DCN-style TCP with length-prefixed pickles replacing
+ps-lite/ZeroMQ.
+
+Bootstrap parity with python/mxnet/kvstore_server.py:11-58: importing
+mxnet_tpu in a process whose ``DMLC_ROLE=server`` starts the server loop and
+exits when a stop command arrives.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+# bound at module import (on the importing thread) — request-handler threads
+# must NOT run `from . import ...`: under the DMLC_ROLE=server bootstrap the
+# main thread is still inside the package import and holds the import lock,
+# so a handler-side relative import deadlocks the whole server
+from . import ndarray as nd
+from . import optimizer as opt
+
+__all__ = ["KVStoreServer", "start_server", "ServerClient",
+           "_init_kvstore_server_module"]
+
+_HDR = struct.Struct("<Q")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class KVStoreServer:
+    """Async parameter server: per-key store + updater applied on every
+    push (async mode, kvstore_dist_server.h:198-206) or after all workers'
+    pushes merge (sync mode, :164-179)."""
+
+    def __init__(self, host="127.0.0.1", port=0, num_workers=1,
+                 sync_mode=False):
+        self.num_workers = num_workers
+        self.sync_mode = sync_mode
+        self.store: Dict[object, np.ndarray] = {}
+        self.updater = None
+        self._lock = threading.Lock()  # single-threaded-executor parity
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition()
+        self._merge: Dict[object, list] = {}
+        self._stop = threading.Event()
+        server_self = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = _recv_msg(self.request)
+                        try:
+                            reply = server_self._dispatch(msg)
+                        except Exception as e:  # keep serving; tell the client
+                            reply = ("err", "%s: %s" % (type(e).__name__, e))
+                        _send_msg(self.request, reply)
+                        if msg[0] == "stop":
+                            break
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.addr = self._server.server_address
+
+    # -- message dispatch --------------------------------------------------
+    def _dispatch(self, msg):
+        cmd = msg[0]
+        if cmd == "init":
+            _, key, arr = msg
+            with self._lock:
+                self.store.setdefault(key, np.array(arr))
+            return ("ok",)
+        if cmd == "push":
+            key, arr = msg[1], msg[2]
+            rank = msg[3] if len(msg) > 3 else 0
+            with self._lock:
+                if self.sync_mode:
+                    # per-worker rounds: a fast worker's next-iteration push
+                    # must not count toward the current round
+                    # (kvstore_dist_server.h:164-179 merges one push per
+                    # worker before the update fires)
+                    rounds = self._merge.setdefault(key, [])
+                    placed = False
+                    for rnd in rounds:
+                        if rank not in rnd:
+                            rnd[rank] = np.asarray(arr)
+                            placed = True
+                            break
+                    if not placed:
+                        rounds.append({rank: np.asarray(arr)})
+                    if rounds and len(rounds[0]) >= self.num_workers:
+                        merged = np.sum(list(rounds.pop(0).values()), axis=0)
+                        self._apply(key, merged)
+                else:
+                    self._apply(key, np.asarray(arr))
+            return ("ok",)
+        if cmd == "pull":
+            _, key = msg
+            with self._lock:
+                if key not in self.store:
+                    return ("err", "uninitialized key %r" % (key,))
+                return ("ok", self.store[key])
+        if cmd == "set_optimizer":
+            optimizer = pickle.loads(msg[1])
+            with self._lock:
+                self.updater = opt.get_updater(optimizer)
+            return ("ok",)
+        if cmd == "barrier":
+            timeout = float(os.environ.get("MXNET_KVSTORE_BARRIER_TIMEOUT",
+                                           "600"))
+            with self._barrier_cv:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count >= self.num_workers:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                else:
+                    released = self._barrier_cv.wait_for(
+                        lambda: self._barrier_gen != gen, timeout=timeout)
+                    if not released:
+                        # undo this waiter's count so later barriers are not
+                        # permanently off by one, and report the failure
+                        if self._barrier_gen == gen:
+                            self._barrier_count -= 1
+                        return ("err",
+                                "barrier timed out after %.0fs" % timeout)
+            return ("ok",)
+        if cmd == "stop":
+            self._stop.set()
+            threading.Thread(target=self._server.shutdown,
+                             daemon=True).start()
+            return ("ok",)
+        return ("err", "unknown command %r" % (cmd,))
+
+    def _apply(self, key, grad):
+        """Run the updater (reference DataHandle: updater_(key, recved,
+        &stored)); without one, accumulate like the reference default."""
+        if key not in self.store:
+            self.store[key] = np.array(grad)
+            return
+        if self.updater is None:
+            self.store[key] = self.store[key] + grad
+            return
+        weight = nd.array(self.store[key])
+        self.updater(key, nd.array(grad), weight)
+        self.store[key] = weight.asnumpy()
+
+    # -- lifecycle ---------------------------------------------------------
+    def serve_forever(self):
+        self._server.serve_forever(poll_interval=0.05)
+
+    def start_background(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def stop(self):
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class ServerClient:
+    """Worker-side connection to a KVStoreServer (the ps::KVWorker role)."""
+
+    def __init__(self, host, port):
+        self._sock = socket.create_connection((host, port), timeout=120)
+        self._lock = threading.Lock()
+
+    def _rpc(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            reply = _recv_msg(self._sock)
+        if reply[0] != "ok":
+            from .base import MXNetError
+
+            raise MXNetError("kvstore server error: %s" % (reply[1],))
+        return reply[1] if len(reply) > 1 else None
+
+    def init(self, key, arr):
+        self._rpc("init", key, np.asarray(arr))
+
+    def push(self, key, arr, rank=0):
+        self._rpc("push", key, np.asarray(arr), rank)
+
+    def pull(self, key):
+        return self._rpc("pull", key)
+
+    def set_optimizer(self, optimizer):
+        self._rpc("set_optimizer",
+                  pickle.dumps(optimizer, pickle.HIGHEST_PROTOCOL))
+
+    def barrier(self):
+        self._rpc("barrier")
+
+    def stop_server(self):
+        self._rpc("stop")
+
+    def close(self):
+        self._sock.close()
+
+
+def start_server(host="127.0.0.1", port=0, num_workers=1, sync_mode=False):
+    """Start a server in this process (background thread); returns it."""
+    srv = KVStoreServer(host, port, num_workers, sync_mode)
+    srv.start_background()
+    return srv
+
+
+def _init_kvstore_server_module():
+    """Reference bootstrap (python/mxnet/kvstore_server.py:11-58): processes
+    launched with DMLC_ROLE=server run the serving loop then exit."""
+    role = os.environ.get("DMLC_ROLE", "")
+    if role != "server":
+        return
+    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    sync = os.environ.get("MXNET_KVSTORE_SYNC", "0") == "1"
+    srv = KVStoreServer(host, port, num_workers, sync_mode=sync)
+    srv.serve_forever()
+    raise SystemExit(0)
+
+
+_init_kvstore_server_module()

@@ -1,0 +1,194 @@
+"""dist_async parameter-service tests (reference:
+tests/nightly/dist_sync_kvstore.py run through tools/launch.py as local
+processes, and the server loop in kvstore_dist_server.h:87-260)."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore_server as kvs
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_server_async_accumulate():
+    """No updater installed: pushes accumulate into the store."""
+    srv = kvs.start_server(num_workers=2)
+    try:
+        host, port = srv.addr
+        c1 = kvs.ServerClient(host, port)
+        c2 = kvs.ServerClient(host, port)
+        c1.init(3, np.zeros((2, 2), np.float32))
+        c1.push(3, np.full((2, 2), 1.0, np.float32))
+        c2.push(3, np.full((2, 2), 2.0, np.float32))
+        out = c1.pull(3)
+        assert_almost_equal(out, np.full((2, 2), 3.0, np.float32))
+    finally:
+        srv.stop()
+
+
+def test_server_async_updater_applied_per_push():
+    """With an SGD updater: every push updates immediately (async PS
+    semantics, kvstore_dist_server.h:198-206)."""
+    srv = kvs.start_server(num_workers=1)
+    try:
+        host, port = srv.addr
+        c = kvs.ServerClient(host, port)
+        c.init("w", np.ones((4,), np.float32))
+        c.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+        c.push("w", np.ones((4,), np.float32))  # w -= 0.5 * 1
+        out1 = c.pull("w")
+        c.push("w", np.ones((4,), np.float32))
+        out2 = c.pull("w")
+        assert out1.mean() < 1.0
+        assert out2.mean() < out1.mean()
+    finally:
+        srv.stop()
+
+
+def test_server_sync_mode_merges_all_workers():
+    """sync_mode: update fires only after num_workers pushes merge
+    (kvstore_dist_server.h:164-179)."""
+    srv = kvs.start_server(num_workers=2, sync_mode=True)
+    try:
+        host, port = srv.addr
+        c1 = kvs.ServerClient(host, port)
+        c2 = kvs.ServerClient(host, port)
+        c1.init(0, np.zeros((3,), np.float32))
+        c1.push(0, np.full((3,), 1.0, np.float32), rank=0)
+        # only one of two workers pushed: store unchanged
+        assert_almost_equal(c1.pull(0), np.zeros((3,), np.float32))
+        c2.push(0, np.full((3,), 2.0, np.float32), rank=1)
+        assert_almost_equal(c1.pull(0), np.full((3,), 3.0, np.float32))
+    finally:
+        srv.stop()
+
+
+def test_server_sync_mode_per_worker_rounds():
+    """A fast worker's second push must open a new round, not complete the
+    current one (reference merges one push per worker per round)."""
+    srv = kvs.start_server(num_workers=2, sync_mode=True)
+    try:
+        host, port = srv.addr
+        c = kvs.ServerClient(host, port)
+        c.init(0, np.zeros((3,), np.float32))
+        c.push(0, np.full((3,), 1.0, np.float32), rank=0)  # round 1
+        c.push(0, np.full((3,), 10.0, np.float32), rank=0)  # round 2
+        # still waiting on worker 1 for round 1
+        assert_almost_equal(c.pull(0), np.zeros((3,), np.float32))
+        c.push(0, np.full((3,), 2.0, np.float32), rank=1)  # completes round 1
+        assert_almost_equal(c.pull(0), np.full((3,), 3.0, np.float32))
+        c.push(0, np.full((3,), 20.0, np.float32), rank=1)  # completes round 2
+        assert_almost_equal(c.pull(0), np.full((3,), 33.0, np.float32))
+    finally:
+        srv.stop()
+
+
+def test_server_error_reply_not_connection_drop():
+    """A failing command must return an err reply, not kill the handler."""
+    import pytest as _pytest
+
+    srv = kvs.start_server(num_workers=1)
+    try:
+        host, port = srv.addr
+        c = kvs.ServerClient(host, port)
+        with _pytest.raises(Exception, match="kvstore server error"):
+            c._rpc("set_optimizer", b"not-a-pickle")
+        # connection still alive and serving
+        c.init(1, np.ones((2,), np.float32))
+        assert_almost_equal(c.pull(1), np.ones((2,), np.float32))
+    finally:
+        srv.stop()
+
+
+def test_server_barrier():
+    srv = kvs.start_server(num_workers=2)
+    try:
+        host, port = srv.addr
+        order = []
+
+        def worker(i):
+            c = kvs.ServerClient(host, port)
+            if i == 1:
+                time.sleep(0.3)
+            c.barrier()
+            order.append(i)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+        t0 = time.time()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert len(order) == 2
+        assert time.time() - t0 >= 0.25  # fast worker waited for slow one
+    finally:
+        srv.stop()
+
+
+def test_dist_async_kvstore_facade():
+    """mx.kvstore.create('dist_async') without env: in-process service;
+    Module-style init/push/pull cycle works."""
+    kv = mx.kvstore.create("dist_async")
+    assert kv.type == "dist_async"
+    assert kv.rank == 0 and kv.num_workers == 1
+    kv.init(9, mx.nd.ones((2, 3)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push(9, [mx.nd.ones((2, 3))])
+    out = mx.nd.zeros((2, 3))
+    kv.pull(9, out=out)
+    assert out.asnumpy().mean() < 1.0
+    kv._send_command_to_servers("stop", "")
+
+
+def test_server_role_bootstrap_subprocess():
+    """Reference launch pattern: a process with DMLC_ROLE=server serves on
+    import; two worker processes push known values; sum must match
+    (tests/nightly/dist_sync_kvstore.py:30-44 analytics)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", DMLC_ROLE="server",
+               DMLC_PS_ROOT_URI="127.0.0.1", DMLC_PS_ROOT_PORT=str(port),
+               DMLC_NUM_WORKER="2")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    server = subprocess.Popen([sys.executable, "-c", "import mxnet_tpu"],
+                              env=env, cwd=repo)
+    try:
+        # wait for the server socket
+        for _ in range(100):
+            try:
+                c = kvs.ServerClient("127.0.0.1", port)
+                break
+            except OSError:
+                time.sleep(0.1)
+        else:
+            pytest.fail("server did not come up")
+        c.init(7, np.zeros((4,), np.float32))
+
+        def worker_main(rank):
+            env_w = dict(env, DMLC_ROLE="worker", DMLC_WORKER_ID=str(rank))
+            code = (
+                "import mxnet_tpu as mx, numpy as np\n"
+                "kv = mx.kvstore.create('dist_async')\n"
+                "kv.push(7, [mx.nd.array(np.full((4,), %d, np.float32))])\n"
+                "kv._barrier()\n" % (rank + 1))
+            return subprocess.Popen([sys.executable, "-c", code], env=env_w,
+                                    cwd=repo)
+        workers = [worker_main(r) for r in range(2)]
+        for w in workers:
+            assert w.wait(timeout=120) == 0
+        out = c.pull(7)
+        assert_almost_equal(out, np.full((4,), 3.0, np.float32))
+        c.stop_server()
+        assert server.wait(timeout=30) == 0
+    finally:
+        if server.poll() is None:
+            server.kill()
