@@ -16,7 +16,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from . import image_backend, io as mxio, ndarray as nd, recordio
+from . import image_backend, io as mxio, native, ndarray as nd, recordio
 
 __all__ = [
     "imdecode", "imresize", "scale_down", "resize_short", "fixed_crop",
@@ -294,6 +294,9 @@ class ImageIter(mxio.DataIter):
         self.label_name = label_name
         self.record = None
         self.imglist = None
+        self._native_reader = None
+        self._native_prefetch = None
+        self._rec_path = path_imgrec
         if path_imgrec:
             idx_path = kwargs.get("path_imgidx",
                                   os.path.splitext(path_imgrec)[0] + ".idx")
@@ -368,7 +371,19 @@ class ImageIter(mxio.DataIter):
         if self.shuffle and self.seq is not None:
             pyrandom.shuffle(self.seq)
         if self.record is not None and self.seq is None:
-            self.record.reset()
+            if native.have_native():
+                # C++ readahead thread (src/recordio.cc prefetcher) for the
+                # sequential scan; Python handle untouched
+                if self._native_prefetch is not None:
+                    self._native_prefetch.close()
+                    self._native_prefetch = None
+                self._native_prefetch = native.NativePrefetchReader(
+                    self._rec_path)
+            else:
+                self.record.reset()
+        elif self.record is not None and native.have_native() and \
+                self._native_reader is None:
+            self._native_reader = native.NativeRecordReader(self._rec_path)
         self.cur = 0
 
     def next_sample(self):
@@ -382,7 +397,15 @@ class ImageIter(mxio.DataIter):
             self.cur += 1
             if self.record is not None:
                 if getattr(self, "_offsets", None) is not None:
-                    self.record.seek(self._offsets[idx])
+                    pos = self._offsets[idx]
+                elif self._native_reader is not None:
+                    pos = self.record.idx[idx]
+                else:
+                    pos = None
+                if self._native_reader is not None and pos is not None:
+                    s = self._native_reader.read_at(pos)
+                elif pos is not None:
+                    self.record.seek(pos)
                     s = self.record.read()
                 else:
                     s = self.record.read_idx(idx)
@@ -392,7 +415,10 @@ class ImageIter(mxio.DataIter):
             with open(os.path.join(self.path_root, fname), "rb") as fin:
                 img = fin.read()
             return label, img
-        s = self.record.read()
+        if self._native_prefetch is not None:
+            s = next(self._native_prefetch)
+        else:
+            s = self.record.read()
         if s is None:
             raise StopIteration
         header, img = recordio.unpack(s)

@@ -1,0 +1,238 @@
+"""ctypes loader for the native runtime library (src/recordio.cc).
+
+The reference's IO hot path is C++ (src/io/, dmlc-core RecordIO +
+ThreadedIter); here the same roles live in libmxtpu.so, loaded via ctypes
+(pybind11 is not in this image). The library self-builds with g++ on first
+use when missing; every native entry point has a pure-Python fallback, so
+the package works without a toolchain (``MXNET_USE_NATIVE=0`` forces the
+fallback).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from .base import env
+
+_LIB = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_PKG_DIR, "libmxtpu.so")
+_SRC_DIR = os.path.join(os.path.dirname(_PKG_DIR), "src")
+
+
+def _build():
+    src = os.path.join(_SRC_DIR, "recordio.cc")
+    if not os.path.exists(src):
+        return False
+    # build to a temp path then rename: concurrent builders and interrupted
+    # builds must never leave a half-written .so at the final path
+    tmp = "%s.build.%d" % (_SO_PATH, os.getpid())
+    try:
+        subprocess.check_call(
+            ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+             "-shared", "-o", tmp, src],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        os.replace(tmp, _SO_PATH)
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _bind(lib):
+    i64, u8p, u8pp, vp, cp = (ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
+                              ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                              ctypes.c_void_p, ctypes.c_char_p)
+    lib.rio_reader_open.restype = vp
+    lib.rio_reader_open.argtypes = [cp]
+    lib.rio_read.restype = i64
+    lib.rio_read.argtypes = [vp, u8pp, ctypes.POINTER(i64)]
+    lib.rio_read_at.restype = i64
+    lib.rio_read_at.argtypes = [vp, i64, u8pp]
+    lib.rio_reader_reset.argtypes = [vp]
+    lib.rio_reader_close.argtypes = [vp]
+    lib.rio_writer_open.restype = vp
+    lib.rio_writer_open.argtypes = [cp]
+    lib.rio_write.restype = i64
+    lib.rio_write.argtypes = [vp, u8p, i64]
+    lib.rio_writer_close.argtypes = [vp]
+    lib.rio_prefetch_open.restype = vp
+    lib.rio_prefetch_open.argtypes = [cp, ctypes.c_int]
+    lib.rio_prefetch_next.restype = i64
+    lib.rio_prefetch_next.argtypes = [vp, u8pp]
+    lib.rio_prefetch_close.argtypes = [vp]
+    lib.rio_free.argtypes = [u8p]
+    lib.rio_abi_version.restype = i64
+    return lib
+
+
+def _load():
+    if not env("MXNET_USE_NATIVE", True, bool):
+        return None
+    for attempt in range(2):
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        try:
+            lib = _bind(ctypes.CDLL(_SO_PATH))
+            if lib.rio_abi_version() == 1:
+                return lib
+        except OSError:
+            pass
+        # stale/corrupt .so (interrupted build, ABI drift): rebuild once
+        try:
+            os.unlink(_SO_PATH)
+        except OSError:
+            return None
+    return None
+
+
+def get_lib():
+    """The loaded native library, or None (pure-Python fallback)."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    with _LOCK:
+        if not _TRIED:
+            _LIB = _load()
+            # publish _TRIED only after _LIB is assigned so the lock-free
+            # fast path never observes a half-initialized state
+            _TRIED = True
+        return _LIB
+
+
+def have_native() -> bool:
+    return get_lib() is not None
+
+
+def _take(lib, ptr, length) -> bytes:
+    try:
+        return ctypes.string_at(ptr, length)
+    finally:
+        lib.rio_free(ptr)
+
+
+class NativeRecordReader:
+    """Sequential/offset reader over libmxtpu (same framing as
+    recordio.MXRecordIO)."""
+
+    def __init__(self, path):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.rio_reader_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+
+    def read(self):
+        if not self._h:
+            raise IOError("reader is closed")
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        off = ctypes.c_int64()
+        n = self._lib.rio_read(self._h, ctypes.byref(buf), ctypes.byref(off))
+        if n < 0:
+            raise IOError("corrupt RecordIO stream")
+        if n == 0 and not buf:
+            return None
+        return _take(self._lib, buf, n)
+
+    def read_at(self, pos):
+        if not self._h:
+            raise IOError("reader is closed")
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.rio_read_at(self._h, pos, ctypes.byref(buf))
+        if n < 0:
+            raise IOError("corrupt RecordIO stream")
+        if n == 0 and not buf:
+            return None
+        return _take(self._lib, buf, n)
+
+    def reset(self):
+        self._lib.rio_reader_reset(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_reader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordWriter:
+    def __init__(self, path):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.rio_writer_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+
+    def write(self, buf: bytes) -> int:
+        arr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+        off = self._lib.rio_write(self._h, arr, len(buf))
+        if off < 0:
+            raise IOError("RecordIO write failed")
+        return off
+
+    def close(self):
+        if self._h:
+            self._lib.rio_writer_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativePrefetchReader:
+    """Background-thread readahead (dmlc::ThreadedIter parity,
+    reference src/io/iter_prefetcher.h:28-129)."""
+
+    def __init__(self, path, capacity=16):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.rio_prefetch_open(path.encode(), capacity)
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._h:
+            raise IOError("prefetch reader is closed")
+        buf = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.rio_prefetch_next(self._h, ctypes.byref(buf))
+        if n < 0:
+            raise IOError("corrupt RecordIO stream")
+        if n == 0 and not buf:
+            raise StopIteration
+        return _take(self._lib, buf, n)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_prefetch_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

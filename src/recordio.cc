@@ -1,0 +1,242 @@
+// Native RecordIO reader/writer + threaded prefetcher for mxnet_tpu.
+//
+// TPU-native equivalent of the reference's dmlc-core RecordIO framing
+// (consumed at /root/reference/src/io/ — iter_image_recordio.cc reads
+// dmlc::InputSplit chunks; iter_prefetcher.h:28-129 double-buffers with
+// dmlc::ThreadedIter).  Same on-disk format as python recordio.py
+// (magic 0xced7230a, little-endian u32 magic+lrec, 4-byte payload pad), so
+// files are interchangeable between the C++ and Python paths and with the
+// reference's packs.
+//
+// Exposed as a flat C ABI consumed via ctypes (no pybind11 in this image).
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLFlagBits = 29;
+constexpr uint32_t kLengthMask = (1u << kLFlagBits) - 1;
+
+struct Reader {
+  FILE* fp = nullptr;
+};
+
+struct Writer {
+  FILE* fp = nullptr;
+};
+
+// one decoded record
+struct Record {
+  std::vector<uint8_t> data;
+  int64_t offset = -1;  // byte offset of the record header in the file
+};
+
+// Bounded-queue threaded prefetcher (dmlc::ThreadedIter semantics: one
+// producer thread reads ahead of the consumer; consumer pops in order).
+struct Prefetcher {
+  FILE* fp = nullptr;
+  std::thread producer;
+  std::mutex mu;
+  std::condition_variable not_empty, not_full;
+  std::deque<Record> queue;
+  size_t capacity = 8;
+  bool eof = false;
+  bool stop = false;
+  std::string error;
+};
+
+bool read_record(FILE* fp, Record* out, std::string* err) {
+  uint32_t head[2];
+  int64_t off =
+#ifdef _WIN32
+      _ftelli64(fp);
+#else
+      ftello(fp);
+#endif
+  size_t n = fread(head, 1, sizeof(head), fp);
+  if (n == 0) return false;  // clean EOF
+  if (n < sizeof(head)) {
+    *err = "truncated record header";
+    return false;
+  }
+  if (head[0] != kMagic) {
+    *err = "invalid RecordIO magic";
+    return false;
+  }
+  uint32_t lrec = head[1];
+  uint32_t length = lrec & kLengthMask;
+  uint32_t cflag = lrec >> kLFlagBits;
+  if (cflag != 0) {
+    *err = "multi-part RecordIO records are not supported";
+    return false;
+  }
+  out->data.resize(length);
+  out->offset = off;
+  if (length && fread(out->data.data(), 1, length, fp) < length) {
+    *err = "truncated record payload";
+    return false;
+  }
+  uint32_t pad = (4 - (length % 4)) % 4;
+  if (pad) fseek(fp, pad, SEEK_CUR);
+  return true;
+}
+
+void producer_loop(Prefetcher* p) {
+  for (;;) {
+    Record rec;
+    std::string err;
+    bool ok = read_record(p->fp, &rec, &err);
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (!ok) {
+      p->eof = true;
+      p->error = err;
+      p->not_empty.notify_all();
+      return;
+    }
+    p->not_full.wait(lk, [p] { return p->queue.size() < p->capacity || p->stop; });
+    if (p->stop) return;
+    p->queue.push_back(std::move(rec));
+    p->not_empty.notify_one();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- sequential reader ----------------------------------------------------
+void* rio_reader_open(const char* path) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return nullptr;
+  auto* r = new Reader();
+  r->fp = fp;
+  return r;
+}
+
+// Returns payload length, 0 on EOF, -1 on error.  Caller frees *out with
+// rio_free.  *offset receives the record's byte offset.
+int64_t rio_read(void* handle, uint8_t** out, int64_t* offset) {
+  auto* r = static_cast<Reader*>(handle);
+  Record rec;
+  std::string err;
+  if (!read_record(r->fp, &rec, &err)) {
+    return err.empty() ? 0 : -1;
+  }
+  *out = static_cast<uint8_t*>(malloc(rec.data.empty() ? 1 : rec.data.size()));
+  memcpy(*out, rec.data.data(), rec.data.size());
+  if (offset) *offset = rec.offset;
+  return static_cast<int64_t>(rec.data.size());
+}
+
+int64_t rio_read_at(void* handle, int64_t pos, uint8_t** out) {
+  auto* r = static_cast<Reader*>(handle);
+#ifdef _WIN32
+  _fseeki64(r->fp, pos, SEEK_SET);
+#else
+  fseeko(r->fp, pos, SEEK_SET);
+#endif
+  return rio_read(handle, out, nullptr);
+}
+
+void rio_reader_reset(void* handle) {
+  auto* r = static_cast<Reader*>(handle);
+  fseek(r->fp, 0, SEEK_SET);
+}
+
+void rio_reader_close(void* handle) {
+  auto* r = static_cast<Reader*>(handle);
+  if (r->fp) fclose(r->fp);
+  delete r;
+}
+
+// ---- writer ---------------------------------------------------------------
+void* rio_writer_open(const char* path) {
+  FILE* fp = fopen(path, "wb");
+  if (!fp) return nullptr;
+  auto* w = new Writer();
+  w->fp = fp;
+  return w;
+}
+
+// Returns the byte offset the record was written at, or -1 on error.
+int64_t rio_write(void* handle, const uint8_t* buf, int64_t len) {
+  auto* w = static_cast<Writer*>(handle);
+  if (len < 0 || static_cast<uint64_t>(len) > kLengthMask) return -1;
+  int64_t off =
+#ifdef _WIN32
+      _ftelli64(w->fp);
+#else
+      ftello(w->fp);
+#endif
+  uint32_t head[2] = {kMagic, static_cast<uint32_t>(len)};
+  if (fwrite(head, 1, sizeof(head), w->fp) < sizeof(head)) return -1;
+  if (len && fwrite(buf, 1, static_cast<size_t>(len), w->fp) <
+                 static_cast<size_t>(len))
+    return -1;
+  uint32_t pad = (4 - (len % 4)) % 4;
+  static const uint8_t zeros[4] = {0, 0, 0, 0};
+  if (pad && fwrite(zeros, 1, pad, w->fp) < pad) return -1;
+  return off;
+}
+
+void rio_writer_close(void* handle) {
+  auto* w = static_cast<Writer*>(handle);
+  if (w->fp) fclose(w->fp);
+  delete w;
+}
+
+// ---- threaded prefetcher --------------------------------------------------
+void* rio_prefetch_open(const char* path, int capacity) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) return nullptr;
+  auto* p = new Prefetcher();
+  p->fp = fp;
+  p->capacity = capacity > 0 ? static_cast<size_t>(capacity) : 8;
+  p->producer = std::thread(producer_loop, p);
+  return p;
+}
+
+// Pops the next prefetched record: returns length, 0 on EOF, -1 on error.
+int64_t rio_prefetch_next(void* handle, uint8_t** out) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->not_empty.wait(lk, [p] { return !p->queue.empty() || p->eof; });
+  if (p->queue.empty()) {
+    return p->error.empty() ? 0 : -1;
+  }
+  Record rec = std::move(p->queue.front());
+  p->queue.pop_front();
+  p->not_full.notify_one();
+  lk.unlock();
+  *out = static_cast<uint8_t*>(malloc(rec.data.empty() ? 1 : rec.data.size()));
+  memcpy(*out, rec.data.data(), rec.data.size());
+  return static_cast<int64_t>(rec.data.size());
+}
+
+void rio_prefetch_close(void* handle) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+  }
+  p->not_full.notify_all();
+  if (p->producer.joinable()) p->producer.join();
+  if (p->fp) fclose(p->fp);
+  delete p;
+}
+
+void rio_free(uint8_t* buf) { free(buf); }
+
+// sanity/version probe for the ctypes loader
+int64_t rio_abi_version() { return 1; }
+
+}  // extern "C"

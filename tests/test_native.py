@@ -1,0 +1,107 @@
+"""Native runtime (src/recordio.cc via ctypes): format interchangeability
+with the Python RecordIO implementation and threaded-prefetch ordering."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import native, recordio
+
+pytestmark = pytest.mark.skipif(not native.have_native(),
+                                reason="native library unavailable")
+
+
+def _payloads(n=50, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.bytes(rng.randint(1, 2000)) for _ in range(n)]
+
+
+def test_python_write_native_read(tmp_path):
+    path = str(tmp_path / "a.rec")
+    payloads = _payloads()
+    w = recordio.MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = native.NativeRecordReader(path)
+    got = []
+    while True:
+        s = r.read()
+        if s is None:
+            break
+        got.append(s)
+    r.close()
+    assert got == payloads
+
+
+def test_native_write_python_read(tmp_path):
+    path = str(tmp_path / "b.rec")
+    payloads = _payloads(seed=1)
+    w = native.NativeRecordWriter(path)
+    offsets = [w.write(p) for p in payloads]
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        s = r.read()
+        if s is None:
+            break
+        got.append(s)
+    r.close()
+    assert got == payloads
+    # offset reads hit the same records
+    nr = native.NativeRecordReader(path)
+    assert nr.read_at(offsets[10]) == payloads[10]
+    assert nr.read_at(offsets[0]) == payloads[0]
+    nr.close()
+
+
+def test_native_prefetch_ordering(tmp_path):
+    path = str(tmp_path / "c.rec")
+    payloads = _payloads(n=200, seed=2)
+    w = native.NativeRecordWriter(path)
+    for p in payloads:
+        w.write(p)
+    w.close()
+    pf = native.NativePrefetchReader(path, capacity=4)
+    got = list(pf)
+    pf.close()
+    assert got == payloads
+
+
+def test_native_reader_reset(tmp_path):
+    path = str(tmp_path / "d.rec")
+    w = native.NativeRecordWriter(path)
+    w.write(b"one")
+    w.write(b"two")
+    w.close()
+    r = native.NativeRecordReader(path)
+    assert r.read() == b"one"
+    r.reset()
+    assert r.read() == b"one"
+    assert r.read() == b"two"
+    assert r.read() is None
+    r.close()
+
+
+def test_corrupt_stream_raises(tmp_path):
+    path = str(tmp_path / "e.rec")
+    with open(path, "wb") as f:
+        f.write(b"\x00" * 32)
+    r = native.NativeRecordReader(path)
+    with pytest.raises(IOError):
+        r.read()
+    r.close()
+
+
+def test_fallback_env_flag(tmp_path, monkeypatch):
+    """MXNET_USE_NATIVE=0 forces the pure-Python path (fresh loader
+    state)."""
+    monkeypatch.setattr(native, "_TRIED", False)
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setenv("MXNET_USE_NATIVE", "0")
+    assert native.get_lib() is None
+    monkeypatch.setattr(native, "_TRIED", False)
+    monkeypatch.delenv("MXNET_USE_NATIVE")
+    assert native.get_lib() is not None
