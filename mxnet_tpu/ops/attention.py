@@ -1,0 +1,164 @@
+"""Fused attention — Pallas TPU kernel (new capability; the reference
+predates attention, SURVEY.md §5.7).
+
+``flash_attention`` computes exact softmax attention with the
+blockwise-online-softmax recurrence entirely in VMEM (the standard
+flash-attention schedule): Q tiles stream over the grid, K/V live in VMEM,
+the running (m, l, o) accumulators never materialize the [s, s] score
+matrix in HBM. Forward is the Pallas kernel; backward is ``custom_vjp``
+recompute through the XLA reference implementation (correct, and XLA fuses
+it well; a hand-written backward kernel can slot in later without changing
+the API).
+
+On non-TPU backends the same kernel runs in Pallas interpret mode, so tests
+on the CPU mesh exercise the real kernel logic. Registered in the op
+registry as ``_contrib_FlashAttention`` (inputs [b, s, h, d]); also usable
+functionally and as ``ulysses_attention(attn_fn=flash_attention)``.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+_NEG = -1e30
+
+
+def _reference_attention(q, k, v, causal, scale):
+    """Dense oracle — the single implementation lives in parallel.ring."""
+    from ..parallel.ring import local_attention
+
+    return local_attention(q, k, v, causal=causal, scale=scale)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, bq, bk, nk, scale, causal):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    d = q.shape[-1]
+    m0 = jnp.full((bq,), _NEG, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    o0 = jnp.zeros((bq, d), jnp.float32)
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+
+    def body(j, carry):
+        o, m, l = carry
+        kblk = k_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[:, None] + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o, m_new, l
+
+    if causal:
+        # blocks strictly above the diagonal contribute nothing; bound the
+        # loop at the current q block's diagonal
+        upto = jnp.minimum((qi + 1) * bq + bk - 1, nk * bk) // bk
+    else:
+        upto = nk
+    o, m, l = jax.lax.fori_loop(0, upto, body, (o0, m0, l0))
+    o_ref[0] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    if sq % bq or sk % bk:
+        raise ValueError(
+            "flash_attention needs seq lengths divisible by block sizes "
+            "(%d %% %d, %d %% %d)" % (sq, bq, sk, bk))
+    # [b, s, h, d] -> [b*h, s, d]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    nk = sk // bk
+    kernel = functools.partial(_flash_kernel, bq=bq, bk=bk, nk=nk,
+                               scale=scale, causal=causal)
+    try:
+        # under shard_map the output must carry the inputs' varying-axis set
+        vma = jax.typeof(qt).vma
+        out_shape = jax.ShapeDtypeStruct((b * h, sq, d), q.dtype, vma=vma)
+    except (AttributeError, TypeError):
+        out_shape = jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale=None,
+                    block_q: int = 128, block_k: int = 128):
+    """Exact fused attention. q, k, v: [batch, seq, heads, head_dim]."""
+    import jax
+
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    interpret = jax.default_backend() != "tpu"
+
+    @jax.custom_vjp
+    def run(q, k, v):
+        return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+
+    def fwd(q, k, v):
+        return run(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q, k, v: _reference_attention(q, k, v, causal, scale),
+            q, k, v)
+        return vjp(g)
+
+    run.defvjp(fwd, bwd)
+    return run(q, k, v)
+
+
+def _register():
+    from .param import Param
+    from .registry import register
+
+    @register("_contrib_FlashAttention", inputs=("query", "key", "value"),
+              params={"causal": Param(bool, False),
+                      "scale": Param("float-or-none", None),
+                      "block_q": Param(int, 128),
+                      "block_k": Param(int, 128)},
+              infer_shape=lambda attrs, s: (s, [s[0]], []),
+              hint="flashattention")
+    def _flash_op(opctx, attrs, query, key, value):
+        return flash_attention(query, key, value,
+                               causal=attrs.get("causal", False),
+                               scale=attrs.get("scale"),
+                               block_q=attrs.get("block_q", 128),
+                               block_k=attrs.get("block_k", 128))
+
+
+_register()

@@ -192,14 +192,14 @@ def _convolution(opctx, attrs, data, weight, *rest):
     pad = _tup(attrs.get("pad"), nd, 0)
     dil = _tup(attrs.get("dilate"), nd, 1)
     dn = _conv_dnums(nd)
+    # no preferred_element_type upcast: the MXU accumulates bf16 matmuls in
+    # f32 internally, and an explicit f32 output breaks the conv transpose
+    # rule under vjp (cotangent f32 vs bf16 operands)
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dil,
         dimension_numbers=dn, feature_group_count=attrs.get("num_group", 1),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
     )
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
     if rest:
         bias = rest[0].reshape((1, -1) + (1,) * nd)
         out = out + bias
