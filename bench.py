@@ -1,20 +1,23 @@
-"""Headline benchmark: ResNet-50 training throughput on one chip.
+"""Headline benchmark: ResNet-50 training throughput on one chip, measured
+through the REAL framework path — Module.bind/init_optimizer +
+forward_backward/update/update_metric, i.e. exactly what
+``examples/image_classification/train_imagenet.py --benchmark 1`` runs.
 
 Reference equivalent: example/image-classification/train_imagenet.py with
 ``--benchmark 1`` (synthetic data, common/fit.py:106-116); reference baseline
 is 181.53 img/s on 1x P100 (docs/how_to/perf.md:130-139).
 
-One fully-jitted train step: forward + backward + SGD-momentum update, mixed
-precision (bf16 compute, f32 master params/momentum), donated buffers. Prints
-ONE JSON line with img/s and MFU.
+The hot loop is ONE fused, donated XLA program per step (Executor.fused_step:
+forward + backward + SGD-momentum update; bf16 compute, f32 master params).
+Prints ONE JSON line with img/s and MFU.
 """
 
 import argparse
 import json
+import os
 import sys
-import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def _peak_flops(backend):
@@ -24,118 +27,44 @@ def _peak_flops(backend):
     return 0.0
 
 
-def _init_graph_np(symbol, input_shapes, seed=0):
-    """Pure-numpy Xavier init — no device dispatches during setup (each
-    imperative init op would round-trip the TPU tunnel)."""
-    rng = np.random.RandomState(seed)
-    arg_shapes, _, aux_shapes = symbol.infer_shape(**input_shapes)
-    args = {}
-    for name, shape in zip(symbol.list_arguments(), arg_shapes):
-        if name in input_shapes:
-            continue
-        if name.endswith("_bias") or name.endswith("_beta"):
-            args[name] = np.zeros(shape, np.float32)
-        elif name.endswith("_gamma"):
-            args[name] = np.ones(shape, np.float32)
-        else:
-            fan_in = int(np.prod(shape[1:])) if len(shape) > 1 else shape[0]
-            fan_out = shape[0]
-            scale = np.sqrt(6.0 / (fan_in + fan_out))
-            args[name] = rng.uniform(-scale, scale, shape).astype(np.float32)
-    aux = {}
-    for name, shape in zip(symbol.list_auxiliary_states(), aux_shapes):
-        aux[name] = (np.ones if name.endswith("_var") else
-                     np.zeros)(shape, np.float32)
-    return args, aux
-
-
-def build_step(batch, num_classes, lr, momentum, wd, compute_dtype):
-    import jax
-    import jax.numpy as jnp
-
-    from mxnet_tpu.executor import _GraphPlan
-    from mxnet_tpu.models import get_resnet
-
-    symbol = get_resnet(num_classes=num_classes, num_layers=50)
-    plan = _GraphPlan(symbol)
-    args_np, aux_np = _init_graph_np(
-        symbol, {"data": (batch, 3, 224, 224), "softmax_label": (batch,)})
-
-    params = {k: jnp.asarray(v) for k, v in args_np.items()}
-    moms = {k: jnp.zeros_like(v) for k, v in params.items()}
-    aux = {k: jnp.asarray(v) for k, v in aux_np.items()}
-    cdt = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
-
-    def loss_fn(params, aux, x, y):
-        args = {k: v.astype(cdt) for k, v in params.items()}
-        args["data"] = x.astype(cdt)
-        args["softmax_label"] = y
-        (probs,), new_aux = plan.run(args, aux, None, True)
-        idx = y.astype(jnp.int32)
-        picked = jnp.take_along_axis(
-            probs.astype(jnp.float32), idx[:, None], axis=1)[:, 0]
-        return -jnp.mean(jnp.log(picked + 1e-8)), new_aux
-
-    def _step(params, moms, aux, x, y):
-        (loss, new_aux), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params, aux, x, y)
-        new_params, new_moms = {}, {}
-        for k in params:
-            g = grads[k].astype(jnp.float32) + wd * params[k]
-            m = momentum * moms[k] - lr * g
-            new_moms[k] = m
-            new_params[k] = params[k] + m
-        return loss, new_params, new_moms, new_aux
-
-    train_step = jax.jit(_step, donate_argnums=(0, 1, 2))
-    return train_step, params, moms, aux
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=None)
-    ap.add_argument("--num-steps", type=int, default=20)
+    ap.add_argument("--num-steps", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--dtype", default="bfloat16",
                     choices=["bfloat16", "float32"])
-    args = ap.parse_args()
+    cli = ap.parse_args()
 
     import jax
-    import jax.numpy as jnp
+    import numpy as np
+
+    from examples.image_classification.common import fit
+    from examples.image_classification.train_imagenet import get_network
 
     backend = jax.default_backend()
-    batch = args.batch_size or (256 if backend == "tpu" else 16)
-    steps = args.num_steps if backend == "tpu" else 3
-    warmup = args.warmup if backend == "tpu" else 1
+    batch = cli.batch_size or (256 if backend == "tpu" else 8)
+    steps = cli.num_steps if backend == "tpu" else 3
+    warmup = cli.warmup if backend == "tpu" else 1
 
-    step, params, moms, aux = build_step(
-        batch, 1000, args.lr, 0.9, 1e-4, args.dtype)
+    parser = argparse.ArgumentParser()
+    fit.add_fit_args(parser)
+    args = parser.parse_args([
+        "--network", "resnet-50", "--num-classes", "1000",
+        "--image-shape", "3,224,224", "--batch-size", str(batch),
+        "--lr", str(cli.lr), "--dtype", cli.dtype, "--benchmark", "1"])
+    net = get_network(args)
 
-    rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.randn(batch, 3, 224, 224).astype(np.float32))
-    y = jnp.asarray((np.arange(batch) % 1000).astype(np.float32))
+    stats = fit.benchmark(args, net, num_steps=steps, warmup=warmup)
 
-    for _ in range(warmup):
-        loss, params, moms, aux = step(params, moms, aux, x, y)
-    float(loss)  # host transfer = hard sync (block_until_ready does not
-    # reliably block under the tunneled-device platform)
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss, params, moms, aux = step(params, moms, aux, x, y)
-    # the final loss depends on every prior step through donated params, so
-    # materializing it on host bounds the whole chain
-    loss_val = float(loss)
-    dt = time.perf_counter() - t0
-
-    if not np.isfinite(loss_val):
+    if not stats.get("finite", True):
         print(json.dumps({"metric": "resnet50_train_throughput", "value": 0.0,
                           "unit": "img/s", "vs_baseline": 0.0,
-                          "error": "non-finite loss"}))
+                          "error": "non-finite parameters after training"}))
         return
 
-    img_per_sec = batch * steps / dt
+    img_per_sec = stats["img_per_sec"]
     # ResNet-50 fwd ~= 4.09 GFLOP/img at 224x224; train ~= 3x fwd
     model_flops = 3 * 4.089e9
     peak = _peak_flops(backend)
@@ -146,11 +75,11 @@ def main():
         "unit": "img/s",
         "vs_baseline": round(img_per_sec / 181.53, 3),
         "batch_size": batch,
-        "dtype": args.dtype,
+        "dtype": cli.dtype,
         "backend": backend,
-        "step_time_ms": round(1000 * dt / steps, 2),
+        "step_time_ms": round(stats["step_time_ms"], 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
-        "loss": round(loss_val, 4),
+        "path": "module",
     }))
 
 

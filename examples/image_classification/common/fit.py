@@ -80,18 +80,74 @@ def _lr_scheduler(args, kv, epoch_size):
                                                 factor=args.lr_factor)
 
 
+def _compute_dtype(args):
+    return args.dtype if args.dtype not in ("float32", None) else None
+
+
+def benchmark(args, network, num_steps=30, warmup=5):
+    """--benchmark mode through the REAL Module path (bind / init_optimizer /
+    forward_backward / update / update_metric — the same statements
+    BaseModule.fit runs), timing steady-state steps with compile excluded.
+    Returns a stats dict; reference equivalent: common/fit.py:106-116
+    synthetic-data mode."""
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    train = SyntheticIter(shape, args.num_classes, args.batch_size,
+                          num_batches=num_steps + warmup)
+    mod = mx.mod.Module(network, context=mx.current_context(),
+                        compute_dtype=_compute_dtype(args))
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label, for_training=True)
+    mod.init_params(initializer=mx.init.Xavier(factor_type="in",
+                                               magnitude=2.34))
+    opt_params = {"learning_rate": args.lr, "wd": args.wd,
+                  "rescale_grad": 1.0 / args.batch_size}
+    if args.optimizer in ("sgd", "nag"):
+        opt_params["momentum"] = args.momentum
+    mod.init_optimizer(kvstore=args.kv_store, optimizer=args.optimizer,
+                       optimizer_params=opt_params)
+    metric = mx.metric.Accuracy()
+    batch = train.next()
+
+    def step():
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+
+    def sync():
+        # pull one small param: its value depends on every prior update, so
+        # this bounds the whole async chain
+        name = mod._exec_group.param_names[-1]
+        return mod._exec_group.execs[0].arg_dict[name].asnumpy()
+
+    for _ in range(warmup):
+        step()
+    sync()
+    t0 = time.time()
+    for _ in range(num_steps):
+        step()
+    sync()
+    dt = time.time() - t0
+    final_param = sync()
+    acc = metric.get()[1]
+    return {"img_per_sec": args.batch_size * num_steps / dt,
+            "step_time_ms": 1000.0 * dt / num_steps,
+            "batch_size": args.batch_size, "dtype": args.dtype,
+            "accuracy": acc,
+            "finite": bool(np.all(np.isfinite(final_param)))}
+
+
 def fit(args, network, data_loader):
     """args: parsed CLI; network: Symbol; data_loader(args, kv) ->
     (train_iter, val_iter_or_None)."""
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)-15s %(message)s")
-    kv = mx.kvstore.create(args.kv_store)
     if args.benchmark:
-        shape = tuple(int(x) for x in args.image_shape.split(","))
-        train = SyntheticIter(shape, args.num_classes, args.batch_size)
-        val = None
-    else:
-        train, val = data_loader(args, kv)
+        stats = benchmark(args, network)
+        print('{"metric": "img_per_sec", "value": %.2f}'
+              % stats["img_per_sec"])
+        return stats
+    kv = mx.kvstore.create(args.kv_store)
+    train, val = data_loader(args, kv)
 
     arg_params = aux_params = None
     begin_epoch = 0
@@ -103,7 +159,8 @@ def fit(args, network, data_loader):
                      args.load_epoch)
 
     epoch_size = max(1, args.num_examples // args.batch_size)
-    mod = mx.mod.Module(network, context=mx.current_context())
+    mod = mx.mod.Module(network, context=mx.current_context(),
+                        compute_dtype=_compute_dtype(args))
     batch_end = [mx.callback.Speedometer(args.batch_size,
                                          args.disp_batches)]
     epoch_end = []
@@ -116,7 +173,6 @@ def fit(args, network, data_loader):
     if sched is not None:
         opt_params["lr_scheduler"] = sched
 
-    t0 = time.time()
     mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
             begin_epoch=begin_epoch, arg_params=arg_params,
             aux_params=aux_params, optimizer=args.optimizer,
@@ -124,8 +180,4 @@ def fit(args, network, data_loader):
             eval_metric=mx.metric.Accuracy(),
             batch_end_callback=batch_end, epoch_end_callback=epoch_end,
             initializer=mx.init.Xavier(factor_type="in", magnitude=2.34))
-    dt = time.time() - t0
-    if args.benchmark:
-        n_img = args.num_epochs * train.num_batches * args.batch_size
-        print('{"metric": "img_per_sec", "value": %.2f}' % (n_img / dt))
     return mod

@@ -118,12 +118,19 @@ class _GraphPlan:
 class Executor:
     def __init__(self, symbol, ctx: Context, args, args_grad=None,
                  grad_req="write", aux_states=None, group2ctx=None,
-                 shared_exec: Optional["Executor"] = None):
+                 shared_exec: Optional["Executor"] = None,
+                 compute_dtype=None, cast_exclude=()):
         from . import ndarray as nd
 
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
         self._group2ctx = group2ctx or {}
+        # mixed precision: float32 args are cast to compute_dtype (bf16 on
+        # TPU) inside the traced step; master params/grads/aux stay float32.
+        # cast_exclude holds names that must keep full precision (labels —
+        # bf16 cannot represent class ids > 256 exactly).
+        self._compute_dtype = compute_dtype
+        self._cast_exclude = frozenset(cast_exclude)
         if shared_exec is not None and shared_exec._symbol is symbol:
             self._plan = shared_exec._plan
         else:
@@ -215,6 +222,26 @@ class Executor:
     # ------------------------------------------------------------------
     # compiled callables
     # ------------------------------------------------------------------
+    def _cast_fn(self):
+        """Build the traced mixed-precision cast over an args dict."""
+        if self._compute_dtype is None:
+            return lambda args: args
+        import jax.numpy as jnp
+
+        cdt = jnp.dtype(self._compute_dtype)
+        exclude = self._cast_exclude
+
+        def cast(args):
+            out = {}
+            for k, v in args.items():
+                if k not in exclude and v.dtype == jnp.float32:
+                    out[k] = v.astype(cdt)
+                else:
+                    out[k] = v
+            return out
+
+        return cast
+
     def _get_fwd(self, is_train: bool, internals: bool = False):
         import jax
 
@@ -223,9 +250,10 @@ class Executor:
             plan = self._plan
 
             placement = self._placement
+            cast = self._cast_fn()
 
             def fn(args, aux, rng):
-                return plan.run(args, aux, rng, is_train,
+                return plan.run(cast(args), aux, rng, is_train,
                                 want_internals=internals, placement=placement)
 
             self._jit_cache[key] = fn if self._naive else jax.jit(fn)
@@ -240,11 +268,13 @@ class Executor:
             remat = bool(env("MXNET_BACKWARD_DO_MIRROR", 0, int))
             placement = self._placement
 
+            cast = self._cast_fn()
+
             def fn(diff_args, other_args, aux, rng, out_grads, old_grads):
                 def f(d):
                     merged = dict(other_args)
                     merged.update(d)
-                    outs, new_aux = plan.run(merged, aux, rng, is_train,
+                    outs, new_aux = plan.run(cast(merged), aux, rng, is_train,
                                              placement=placement)
                     return tuple(outs), new_aux
 
@@ -262,6 +292,178 @@ class Executor:
 
             self._jit_cache[key] = fn if self._naive else jax.jit(fn)
         return self._jit_cache[key]
+
+    # ------------------------------------------------------------------
+    # fused train step (forward + backward + optimizer update)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _unwrap_state(state):
+        """Optimizer state (NDArray / tuple / None) → jax pytree."""
+        from . import ndarray as nd
+
+        if state is None:
+            return None
+        if isinstance(state, nd.NDArray):
+            return state._data
+        if isinstance(state, (list, tuple)):
+            return tuple(Executor._unwrap_state(s) for s in state)
+        return state
+
+    @staticmethod
+    def _rewrap_state(holder, new, ctx):
+        """Write a new jax pytree back into the Updater's NDArray structure
+        (buffer rebinding only — no device work)."""
+        from . import ndarray as nd
+
+        if holder is None or new is None:
+            return holder if new is None else nd.NDArray(new, ctx)
+        if isinstance(holder, nd.NDArray):
+            holder._set(new)
+            return holder
+        if isinstance(holder, (list, tuple)):
+            return tuple(Executor._rewrap_state(h, n, ctx)
+                         for h, n in zip(holder, new))
+        return new
+
+    def _get_fused_step(self, key, update_infos, pure_update, needs_rng):
+        """Jitted forward+backward+update with donated param/state/aux
+        buffers.  This is the whole of the reference's per-batch engine
+        traffic (GraphExecutor::Forward/Backward + the kvstore push/pull +
+        fused optimizer kernels, model.py:88-116) as ONE XLA program — no
+        host dispatch per parameter, buffers reused in place via donation."""
+        import jax
+        import jax.numpy as jnp
+
+        if key not in self._jit_cache:
+            plan = self._plan
+            placement = self._placement
+            remat = bool(env("MXNET_BACKWARD_DO_MIRROR", 0, int))
+            cast = self._cast_fn()
+
+            def fn(diff_args, states, aux, other_args, rng, sc, opt_rng):
+                lr0, wd0, t = sc
+
+                def f(d):
+                    merged = dict(other_args)
+                    merged.update(d)
+                    outs, new_aux = plan.run(cast(merged), aux, rng, True,
+                                             placement=placement)
+                    return tuple(outs), new_aux
+
+                f2 = jax.checkpoint(f) if remat else f
+                primals, vjp_fn = jax.vjp(f2, diff_args)
+                outs, new_aux = primals
+                cts = tuple(jnp.ones_like(o) for o in outs)
+                (grads,) = vjp_fn((cts, jax.tree_util.tree_map(
+                    jnp.zeros_like, new_aux)))
+                keys = {}
+                if needs_rng and opt_rng is not None:
+                    subkeys = jax.random.split(opt_rng, len(update_infos))
+                    keys = {name: subkeys[i]
+                            for i, (name, _, _, _) in enumerate(update_infos)}
+                new_params = {}
+                new_states = {}
+                for name, _idx, lmult, wmult in update_infos:
+                    w, s = pure_update(
+                        diff_args[name], grads[name], states[name],
+                        lr0 * lmult, wd0 * wmult, t, keys.get(name))
+                    new_params[name] = w
+                    new_states[name] = s
+                return list(outs), new_aux, new_params, new_states
+
+            self._jit_cache[key] = fn if self._naive else \
+                jax.jit(fn, donate_argnums=(0, 1, 2))
+        return self._jit_cache[key]
+
+    def fused_step(self, optimizer, updater, param_names):
+        """Run one fused train step: loads nothing (inputs must already be in
+        ``arg_dict``), updates params/states/aux in place, sets outputs.
+
+        ``param_names`` gives the updater index space (position in list ==
+        kvstore key, as Module wires idx2name).  Requires every param's
+        grad_req to be 'write' or 'null' and an optimizer with
+        ``pure_update``."""
+        import numpy as _np
+        from . import ndarray as nd
+        from . import random as _random
+
+        plan = self._plan
+        infos = []
+        for idx, name in enumerate(param_names):
+            if self._grad_req.get(name, "null") == "null":
+                continue
+            if idx not in updater.states:
+                updater.states[idx] = optimizer.create_state(
+                    idx, self.arg_dict[name])
+            # static per-param multipliers (scheduler lr stays traced)
+            lmult = optimizer.lr_mult.get(idx, optimizer.lr_mult.get(
+                optimizer.idx2name.get(idx, name), 1.0))
+            wmult = optimizer.wd_mult.get(idx, optimizer.wd_mult.get(
+                optimizer.idx2name.get(idx, name), 1.0))
+            infos.append((name, idx, float(lmult), float(wmult)))
+            optimizer._update_count(idx)
+
+        t = optimizer.num_update
+        lr0 = optimizer.lr_scheduler(t) if optimizer.lr_scheduler is not None \
+            else optimizer.lr
+        sc = (_np.float32(lr0), _np.float32(optimizer.wd), _np.int32(t))
+
+        diff_args = {}
+        states = {}
+        other_args = {}
+        diff_set = {name for name, _, _, _ in infos}
+        for k, v in self.arg_dict.items():
+            (diff_args if k in diff_set else other_args)[k] = v._data
+        for name, idx, _, _ in infos:
+            states[name] = self._unwrap_state(updater.states[idx])
+        aux = {k: v._data for k, v in self.aux_dict.items()}
+
+        # donation requires distinct buffers; NDArray.copy() shares the
+        # immutable jax array (e.g. DCASGD's previous-weight state right
+        # after create_state), so break aliases with a real copy once
+        import jax
+
+        seen = {id(v) for v in diff_args.values()}
+
+        def _dedupe(leaf):
+            if leaf is None:
+                return None
+            if id(leaf) in seen:
+                return jax.numpy.array(leaf, copy=True)
+            seen.add(id(leaf))
+            return leaf
+
+        states = jax.tree_util.tree_map(_dedupe, states)
+        aux = {k: _dedupe(v) for k, v in aux.items()}
+        rng = _random.next_key() if plan.stochastic_nodes else None
+        opt_rng = _random.next_key() if optimizer.needs_rng else None
+
+        # hyperparameters are baked into the trace, so fingerprint every
+        # scalar hyper (momentum, betas, rho, ...) — not just identity —
+        # excluding per-step bookkeeping and the traced lr/wd scalars
+        hypers = tuple(sorted(
+            (k, float(v)) for k, v in vars(optimizer).items()
+            if isinstance(v, (int, float, bool)) and
+            k not in ("num_update", "begin_num_update", "lr", "wd")))
+        key = ("fused", tuple(infos), id(optimizer), type(optimizer).__name__,
+               hypers, float(optimizer.rescale_grad),
+               float(optimizer.clip_gradient or 0.0))
+        fn = self._get_fused_step(key, tuple(infos), optimizer.pure_update,
+                                  optimizer.needs_rng)
+        outs, new_aux, new_params, new_states = fn(
+            diff_args, states, aux, other_args, rng, sc, opt_rng)
+
+        for name, idx, _, _ in infos:
+            self.arg_dict[name]._set(new_params[name])
+            updater.states[idx] = self._rewrap_state(
+                updater.states[idx], new_states[name], self._ctx)
+        for k, v in new_aux.items():
+            self.aux_dict[k]._set(v)
+        self._output_arrays = [nd.NDArray(o, self._ctx) for o in outs]
+        if self._naive:
+            for o in self._output_arrays:
+                o.wait_to_read()
+        return self._output_arrays
 
     # ------------------------------------------------------------------
     # execution API

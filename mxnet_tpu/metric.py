@@ -50,8 +50,27 @@ class EvalMetric:
         else:
             self.num_inst = [0] * self.num
             self.sum_metric = [0.0] * self.num
+        self._device_sum = None  # lazily-synced on-device accumulator
+
+    def _accumulate_device(self, value, count):
+        """Accumulate a device scalar without a host round-trip.  The sync
+        moves from every batch to every get() call (Speedometer cadence), so
+        the dispatch queue stays ahead of the host — the TPU analogue of the
+        reference's async-engine metric design where asnumpy was the only
+        sync point."""
+        if self._device_sum is None:
+            self._device_sum = value
+        else:
+            self._device_sum = self._device_sum + value
+        self.num_inst += count
+
+    def _materialize(self):
+        if self._device_sum is not None:
+            self.sum_metric += float(self._device_sum)
+            self._device_sum = None
 
     def get(self):
+        self._materialize()
         if self.num is None:
             if self.num_inst == 0:
                 return (self.name, float("nan"))
@@ -123,11 +142,32 @@ class Accuracy(EvalMetric):
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
         for label, pred_label in zip(labels, preds):
-            pred = pred_label.asnumpy()
+            if isinstance(pred_label, NDArray) and isinstance(label, NDArray):
+                # on-device compare + lazy sync (see _accumulate_device)
+                import jax
+                import jax.numpy as jnp
+
+                pred = pred_label._data
+                lab = label._data
+                if pred.ndim > 1 and pred.shape != lab.shape:
+                    pred = jnp.argmax(pred, axis=1)
+                pred = pred.astype(jnp.int32).ravel()
+                # labels usually live on one device while preds may be
+                # mesh-sharded: colocate before the eager compare
+                if getattr(lab, "sharding", None) != getattr(
+                        pred, "sharding", None):
+                    lab = jax.device_put(lab, pred.sharding)
+                correct = jnp.sum(pred == lab.astype(jnp.int32).ravel())
+                self._accumulate_device(correct, int(lab.size))
+                continue
+            pred = pred_label.asnumpy() if isinstance(pred_label, NDArray) \
+                else numpy.asarray(pred_label)
             if pred.ndim > 1 and pred.shape != label.shape:
                 pred = numpy.argmax(pred, axis=1)
             pred = pred.astype("int32")
-            label_np = label.asnumpy().astype("int32")
+            label_np = label.asnumpy().astype("int32") \
+                if isinstance(label, NDArray) \
+                else numpy.asarray(label).astype("int32")
             check_label_shapes(label_np, pred)
             self.sum_metric += (pred.flat == label_np.flat).sum()
             self.num_inst += len(pred.flat)

@@ -35,9 +35,10 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=logging, fixed_param_names=None,
-                 grad_req="write", state_names=None):
+                 grad_req="write", state_names=None, compute_dtype=None):
         self.symbol = symbol
         self.contexts = contexts
+        self.compute_dtype = compute_dtype
         if workload and len(set(workload)) > 1:
             raise MXNetError(
                 "work_load_list with uneven splits is unsupported on a device "
@@ -181,7 +182,9 @@ class DataParallelExecutorGroup:
                 aux[name] = nd.zeros(shape, ctx0, dtype=dtype)
 
         executor = Executor(self.symbol, ctx0, args, grads or None,
-                            self.grad_req, aux, shared_exec=shared_exec)
+                            self.grad_req, aux, shared_exec=shared_exec,
+                            compute_dtype=self.compute_dtype,
+                            cast_exclude=self.label_names)
         self.execs = [executor]
         if self._mesh is not None:
             self._apply_shardings(executor)
@@ -290,6 +293,13 @@ class DataParallelExecutorGroup:
         """Fused fwd+bwd in one XLA program — the TPU hot path."""
         self._load_batch(data_batch)
         self.execs[0].forward_backward()
+
+    def fused_step(self, data_batch, optimizer, updater):
+        """Fully-fused train step: fwd+bwd+optimizer update as ONE donated
+        XLA program (Executor.fused_step) — replaces forward_backward +
+        the per-key kvstore push/pull loop of the reference hot path."""
+        self._load_batch(data_batch)
+        self.execs[0].fused_step(optimizer, updater, self.param_names)
 
     def get_outputs(self, merge_multi_context=True):
         return list(self.execs[0].outputs)

@@ -30,8 +30,12 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, compute_dtype=None):
         super().__init__(logger=logger)
+        # TPU-native mixed precision: compute in bf16, keep f32 master
+        # params/grads/optimizer state (no reference equivalent — the
+        # reference casts the symbol to fp16 instead)
+        self._compute_dtype = compute_dtype
         if context is None:
             context = ctx_mod.current_context()
         if isinstance(context, ctx_mod.Context):
@@ -70,6 +74,8 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._fused_ok = False
+        self._fused_pending = None
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -235,7 +241,8 @@ class Module(BaseModule):
             self._symbol, self._context, self._work_load_list,
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group, self.logger,
-            self._fixed_param_names, grad_req, state_names=self._state_names)
+            self._fixed_param_names, grad_req, state_names=self._state_names,
+            compute_dtype=self._compute_dtype)
         self._total_exec_bytes = 0
 
         if shared_module is not None:
@@ -319,10 +326,35 @@ class Module(BaseModule):
             self._updater = opt.get_updater(optimizer)
 
         self.optimizer_initialized = True
+        self._fused_ok = self._decide_fused()
 
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
+
+    def _decide_fused(self):
+        """Whether update() can run as ONE jitted fwd+bwd+optimizer program
+        (Executor.fused_step).  Requires the replicated-updater path (no
+        server-side aggregation), an optimizer with a traceable update rule,
+        plain grad_req='write', and no monitor hook (which needs eager
+        internals).  MXNET_FUSED_STEP=0 is the escape hatch back to the
+        reference-style eager per-key loop."""
+        from ..base import env
+
+        if env("MXNET_FUSED_STEP", "1", str) == "0":
+            return False
+        if self._update_on_kvstore or self._updater is None:
+            return False
+        if self._kvstore is not None and "dist" in self._kvstore.type:
+            return False
+        if not type(self._optimizer).has_pure_update():
+            return False
+        if any(self._exec_group.grad_req.get(n) == "add"
+               for n in self._param_names):
+            return False
+        if self._exec_group._monitor_callback is not None:
+            return False
+        return True
 
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
@@ -335,22 +367,46 @@ class Module(BaseModule):
     # ------------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        # run any deferred fused batch first so its grads/outputs are not
+        # interleaved with (or clobbered by) this forward
+        self._flush_fused_pending()
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        self._flush_fused_pending()
         self._exec_group.backward(out_grads=out_grads)
 
     def forward_backward(self, data_batch):
-        """Fused forward+backward — one XLA program per batch."""
+        """Fused forward+backward — one XLA program per batch.  When the
+        fully-fused step is enabled, execution is deferred to update() so
+        forward, backward, AND the optimizer run as a single donated XLA
+        program (see _decide_fused)."""
         assert self.binded and self.params_initialized
+        if self._fused_ok and self.optimizer_initialized:
+            self._fused_pending = data_batch
+            return
         self._exec_group.forward_backward(data_batch)
 
+    def _flush_fused_pending(self):
+        """A caller wants grads/outputs before update(): fall back to the
+        two-phase path for this batch."""
+        if self._fused_pending is not None:
+            batch, self._fused_pending = self._fused_pending, None
+            self._exec_group.forward_backward(batch)
+
     def update(self):
-        """Apply the optimizer to every parameter (reference module.py:553)."""
+        """Apply the optimizer to every parameter (reference module.py:553).
+        On the fused path this runs the whole pending train step as one
+        compiled program; otherwise the reference's eager per-key
+        push/pull/updater loop."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        if self._fused_pending is not None:
+            batch, self._fused_pending = self._fused_pending, None
+            self._exec_group.fused_step(batch, self._optimizer, self._updater)
+            return
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
                                       self._exec_group.grad_arrays,
@@ -364,13 +420,16 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        self._flush_fused_pending()
         return self._exec_group.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and self.inputs_need_grad
+        self._flush_fused_pending()
         return self._exec_group.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        self._flush_fused_pending()
         self._exec_group.update_metric(eval_metric, labels)
 
     # ------------------------------------------------------------------
@@ -396,4 +455,6 @@ class Module(BaseModule):
 
     def install_monitor(self, mon):
         assert self.binded
+        self._fused_ok = False  # monitor needs eager per-tensor internals
+        self._flush_fused_pending()
         self._exec_group.install_monitor(mon)

@@ -377,18 +377,27 @@ def _batch_norm(opctx, attrs, data, gamma, beta, moving_mean, moving_var):
     bshape = (1, -1) + (1,) * (data.ndim - 2) if data.ndim > 1 else (-1,)
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
+    # statistics in f32 regardless of compute dtype: bf16 mean/var over a
+    # large batch loses precision; the normalize itself stays in data.dtype
+    # (scale/shift folded to one per-channel FMA)
+    x32 = data if data.dtype == jnp.float32 else data.astype(jnp.float32)
     if use_global:
         mean, var = moving_mean, moving_var
         new_mm, new_mv = moving_mean, moving_var
     else:
-        mean = jnp.mean(data, axis=axes)
-        var = jnp.var(data, axis=axes)
+        mean = jnp.mean(x32, axis=axes)
+        var = jnp.var(x32, axis=axes)
         new_mm = momentum * moving_mean + (1 - momentum) * lax.stop_gradient(mean)
         new_mv = momentum * moving_var + (1 - momentum) * lax.stop_gradient(var)
-    inv = lax.rsqrt(var.reshape(bshape) + eps)
-    out = (data - mean.reshape(bshape)) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
+    inv = lax.rsqrt(var + eps)
+    g32 = gamma.astype(jnp.float32)
+    scale = (g32 * inv).astype(data.dtype).reshape(bshape)
+    shift = (beta.astype(jnp.float32) - mean * inv * g32).astype(
+        data.dtype).reshape(bshape)
+    out = data * scale + shift
     if attrs.get("output_mean_var"):
-        return out, mean, var, new_mm, new_mv
+        return (out, mean.astype(data.dtype), var.astype(data.dtype),
+                new_mm, new_mv)
     return out, new_mm, new_mv
 
 

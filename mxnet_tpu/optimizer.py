@@ -90,6 +90,38 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError("virtual Optimizer.update")
 
+    # -- fused-step support ------------------------------------------------
+    #: set True by rules that draw noise inside ``pure_update``
+    needs_rng = False
+
+    def pure_update(self, weight, grad, state, lr, wd, t, rng=None):
+        """Traceable functional form of :meth:`update` for the fused train
+        step (Executor.fused_step): given jax arrays, return
+        ``(new_weight, new_state)`` with no side effects.  ``lr``/``wd`` are
+        traced scalars with per-param multipliers already applied; ``t`` is
+        the traced update count (bias correction); ``rng`` a PRNG key when
+        :attr:`needs_rng`.  Optimizers that don't implement it fall back to
+        the eager per-key path.  Counterpart of the reference's fused update
+        kernels (src/operator/optimizer_op.cc:18-73) running *inside* the
+        jitted step instead of as separate engine pushes."""
+        raise NotImplementedError
+
+    @classmethod
+    def has_pure_update(cls):
+        return cls.pure_update is not Optimizer.pure_update
+
+    def _pure_grad(self, weight, grad, wd=None):
+        """Shared rescale/clip/wd preamble in traced form."""
+        import jax.numpy as jnp
+
+        g = grad.astype(weight.dtype) if grad.dtype != weight.dtype else grad
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None and self.clip_gradient > 0:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        if wd is not None:
+            g = g + wd * weight
+        return g
+
     # -- multipliers -------------------------------------------------------
     def set_lr_scale(self, args_lrscale):  # deprecated reference surface
         raise DeprecationWarning("Use set_lr_mult instead.")
@@ -190,6 +222,13 @@ class SGD(Optimizer):
         else:
             nd.sgd_update(weight, grad, out=weight, **kwargs)
 
+    def pure_update(self, weight, grad, state, lr, wd, t, rng=None):
+        g = self._pure_grad(weight, grad, wd)
+        if state is None:
+            return weight - lr * g, None
+        new_mom = self.momentum * state - lr * g
+        return weight + new_mom, new_mom
+
 
 @register
 class DCASGD(Optimizer):
@@ -227,6 +266,18 @@ class DCASGD(Optimizer):
         previous_weight._set(weight._data)
         weight._set(weight._data + delta)
 
+    def pure_update(self, weight, grad, state, lr, wd, t, rng=None):
+        g = self._pure_grad(weight, grad)
+        mom, prev = state
+        comp = g + wd * weight + self.lamda * g * g * (weight - prev)
+        if mom is not None:
+            new_mom = self.momentum * mom - lr * comp
+            delta = new_mom
+        else:
+            new_mom = None
+            delta = -lr * comp
+        return weight + delta, (new_mom, weight)
+
 
 @register
 class NAG(SGD):
@@ -251,6 +302,14 @@ class NAG(SGD):
             assert self.momentum == 0.0
             weight._set(weight._data - lr * (g + wd * weight._data))
 
+    def pure_update(self, weight, grad, state, lr, wd, t, rng=None):
+        g = self._pure_grad(weight, grad)
+        gw = g + wd * weight
+        if state is None:
+            return weight - lr * gw, None
+        new_mom = self.momentum * state + gw
+        return weight - lr * (gw + self.momentum * new_mom), new_mom
+
 
 @register
 class SGLD(Optimizer):
@@ -269,6 +328,17 @@ class SGLD(Optimizer):
         noise = jax.random.normal(_random.next_key(), weight.shape,
                                   dtype=weight._data.dtype) * math.sqrt(lr)
         weight._set(weight._data - lr / 2 * (g + wd * weight._data) + noise)
+
+    needs_rng = True
+
+    def pure_update(self, weight, grad, state, lr, wd, t, rng=None):
+        import jax
+        import jax.numpy as jnp
+
+        g = self._pure_grad(weight, grad, wd)
+        noise = jax.random.normal(rng, weight.shape,
+                                  dtype=weight.dtype) * jnp.sqrt(lr)
+        return weight - lr / 2 * g + noise, None
 
 
 @register
@@ -308,6 +378,18 @@ class Adam(Optimizer):
                        clip_gradient=self.clip_gradient
                        if self.clip_gradient is not None else -1.0)
 
+    def pure_update(self, weight, grad, state, lr, wd, t, rng=None):
+        import jax.numpy as jnp
+
+        g = self._pure_grad(weight, grad, wd)
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        lr_t = lr * jnp.sqrt(1.0 - self.beta2 ** tf) / (1.0 - self.beta1 ** tf)
+        mean, var = state
+        new_mean = self.beta1 * mean + (1.0 - self.beta1) * g
+        new_var = self.beta2 * var + (1.0 - self.beta2) * jnp.square(g)
+        w = weight - lr_t * new_mean / (jnp.sqrt(new_var) + self.epsilon)
+        return w, (new_mean, new_var)
+
 
 @register
 class AdaGrad(Optimizer):
@@ -333,6 +415,15 @@ class AdaGrad(Optimizer):
         weight._set(weight._data - lr * (
             g / jnp.sqrt(history + self.float_stable_eps)
             + wd * weight._data))
+
+    def pure_update(self, weight, grad, state, lr, wd, t, rng=None):
+        import jax.numpy as jnp
+
+        g = self._pure_grad(weight, grad)
+        history = state + jnp.square(g)
+        w = weight - lr * (g / jnp.sqrt(history + self.float_stable_eps)
+                           + wd * weight)
+        return w, history
 
 
 @register
@@ -375,6 +466,27 @@ class RMSProp(Optimizer):
             nd.rmspropalex_update(weight, grad, n, g, delta, out=weight,
                                   gamma2=self.gamma2, **kwargs)
 
+    def pure_update(self, weight, grad, state, lr, wd, t, rng=None):
+        import jax.numpy as jnp
+
+        g = self._pure_grad(weight, grad, wd)
+        if not self.centered:
+            (n,) = state
+            new_n = (1.0 - self.gamma1) * jnp.square(g) + self.gamma1 * n
+            w = weight - lr * g / jnp.sqrt(new_n + self.epsilon)
+            new_state = (new_n,)
+        else:
+            n, gs, delta = state
+            new_n = (1.0 - self.gamma1) * jnp.square(g) + self.gamma1 * n
+            new_g = (1.0 - self.gamma1) * g + self.gamma1 * gs
+            new_delta = self.gamma2 * delta - lr * g / jnp.sqrt(
+                new_n - jnp.square(new_g) + self.epsilon)
+            w = weight + new_delta
+            new_state = (new_n, new_g, new_delta)
+        if self.clip_weights is not None and self.clip_weights > 0:
+            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+        return w, new_state
+
 
 @register
 class AdaDelta(Optimizer):
@@ -406,6 +518,17 @@ class AdaDelta(Optimizer):
         acc_delta._set(new_acc_delta)
         weight._set(weight._data - (delta + wd * weight._data))
 
+    def pure_update(self, weight, grad, state, lr, wd, t, rng=None):
+        import jax.numpy as jnp
+
+        g = self._pure_grad(weight, grad)
+        acc_g, acc_delta = state
+        new_acc_g = self.rho * acc_g + (1.0 - self.rho) * jnp.square(g)
+        delta = (jnp.sqrt(acc_delta + self.epsilon)
+                 / jnp.sqrt(new_acc_g + self.epsilon)) * g
+        new_acc_delta = self.rho * acc_delta + (1.0 - self.rho) * jnp.square(delta)
+        return weight - (delta + wd * weight), (new_acc_g, new_acc_delta)
+
 
 @register
 class Test(Optimizer):
@@ -418,6 +541,10 @@ class Test(Optimizer):
     def update(self, index, weight, grad, state):
         weight._set(weight._data + grad._data * self.rescale_grad)
         state._set(weight._data)
+
+    def pure_update(self, weight, grad, state, lr, wd, t, rng=None):
+        w = weight + grad.astype(weight.dtype) * self.rescale_grad
+        return w, w
 
 
 class Updater:

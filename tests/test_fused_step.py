@@ -1,0 +1,176 @@
+"""Fused train step (Executor.fused_step): the single-program
+fwd+bwd+optimizer path must match the reference-style eager per-key loop
+(MXNET_FUSED_STEP=0) bit-for-bit in f32, across optimizers, and support the
+bf16 compute_dtype mixed-precision mode."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _make_module(seed=0, compute_dtype=None, optimizer="sgd", opt_params=None,
+                 fused=True):
+    os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+    try:
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+        net = mx.sym.Activation(net, name="relu1", act_type="relu")
+        net = mx.sym.BatchNorm(net, name="bn1")
+        net = mx.sym.FullyConnected(net, name="fc2", num_hidden=4)
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu(),
+                            compute_dtype=compute_dtype)
+        mod.bind(data_shapes=[("data", (8, 10))],
+                 label_shapes=[("softmax_label", (8,))])
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        mod.init_params(initializer=mx.init.Xavier(), force_init=True)
+        params = opt_params or {"learning_rate": 0.05}
+        mod.init_optimizer(optimizer=optimizer, optimizer_params=params,
+                           force_init=True)
+    finally:
+        os.environ.pop("MXNET_FUSED_STEP", None)
+    return mod
+
+
+def _run_steps(mod, n=3, seed=0):
+    rng = np.random.RandomState(seed)
+    metric = mx.metric.Accuracy()
+    for _ in range(n):
+        x = mx.nd.array(rng.randn(8, 10).astype(np.float32))
+        y = mx.nd.array(rng.randint(0, 4, (8,)).astype(np.float32))
+        batch = mx.io.DataBatch(data=[x], label=[y], pad=0)
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+    args, auxs = mod.get_params()
+    return ({k: v.asnumpy() for k, v in args.items()},
+            {k: v.asnumpy() for k, v in auxs.items()},
+            metric.get()[1])
+
+
+OPTIMIZERS = [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-3}),
+    ("sgd", {"learning_rate": 0.05}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.05}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01, "centered": True}),
+    ("adadelta", {}),
+    ("dcasgd", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("test", {}),
+]
+
+
+@pytest.mark.parametrize("opt,params", OPTIMIZERS)
+def test_fused_matches_eager(opt, params):
+    mod_f = _make_module(optimizer=opt, opt_params=dict(params), fused=True)
+    assert mod_f._fused_ok, "fused path should be active for %s" % opt
+    args_f, aux_f, acc_f = _run_steps(mod_f)
+
+    mod_e = _make_module(optimizer=opt, opt_params=dict(params), fused=False)
+    assert not mod_e._fused_ok
+    args_e, aux_e, acc_e = _run_steps(mod_e)
+
+    for k in args_e:
+        np.testing.assert_allclose(args_f[k], args_e[k], rtol=2e-5,
+                                   atol=2e-6, err_msg=k)
+    for k in aux_e:
+        np.testing.assert_allclose(aux_f[k], aux_e[k], rtol=2e-5,
+                                   atol=2e-6, err_msg=k)
+    assert acc_f == pytest.approx(acc_e)
+
+
+def test_fused_sgld_runs():
+    mod = _make_module(optimizer="sgld",
+                       opt_params={"learning_rate": 1e-3}, fused=True)
+    assert mod._fused_ok
+    args, _, _ = _run_steps(mod)
+    for v in args.values():
+        assert np.all(np.isfinite(v))
+
+
+def test_fused_bf16_compute():
+    mod = _make_module(compute_dtype="bfloat16", fused=True)
+    assert mod._fused_ok
+    args, auxs, _ = _run_steps(mod, n=5)
+    # master params stay f32 and finite; BN moving stats stay f32
+    for v in args.values():
+        assert v.dtype == np.float32
+        assert np.all(np.isfinite(v))
+    for v in auxs.values():
+        assert v.dtype == np.float32
+
+
+def test_fused_converges():
+    # tiny 2-class problem learnable in a few steps through the fused path
+    rng = np.random.RandomState(3)
+    x = rng.randn(64, 10).astype(np.float32)
+    w = rng.randn(10)
+    y = (x @ w > 0).astype(np.float32)
+    mod = _make_module(optimizer="sgd",
+                       opt_params={"learning_rate": 0.1, "momentum": 0.9})
+    metric = mx.metric.Accuracy()
+    for i in range(200):
+        b = mx.io.DataBatch(data=[mx.nd.array(x[(i % 8) * 8:(i % 8 + 1) * 8])],
+                            label=[mx.nd.array(y[(i % 8) * 8:(i % 8 + 1) * 8])],
+                            pad=0)
+        mod.forward_backward(b)
+        mod.update()
+    metric.reset()
+    for i in range(8):
+        b = mx.io.DataBatch(data=[mx.nd.array(x[i * 8:(i + 1) * 8])],
+                            label=[mx.nd.array(y[i * 8:(i + 1) * 8])], pad=0)
+        mod.forward(b, is_train=False)
+        mod.update_metric(metric, b.label)
+    assert metric.get()[1] > 0.9
+
+
+def test_fused_lr_scheduler_no_retrace():
+    # scheduler-driven lr changes must not recompile: lr is a traced scalar
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    mod = _make_module(optimizer="sgd",
+                       opt_params={"learning_rate": 0.1,
+                                   "lr_scheduler": sched})
+    _run_steps(mod, n=6)
+    execu = mod._exec_group.execs[0]
+    fused_keys = [k for k in execu._jit_cache if k[0] == "fused"]
+    assert len(fused_keys) == 1
+    assert mod._optimizer.num_update == 6
+
+
+def test_fused_outputs_before_update_fall_back():
+    # reading outputs between forward_backward and update falls back to the
+    # two-phase path for that batch, keeping semantics
+    mod = _make_module()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 10).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, 4, (8,)).astype(np.float32))
+    batch = mx.io.DataBatch(data=[x], label=[y], pad=0)
+    mod.forward_backward(batch)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (8, 4)
+    mod.update()  # eager path applies the materialized grads
+    args, _ = mod.get_params()
+    assert all(np.all(np.isfinite(v.asnumpy())) for v in args.values())
+
+
+def test_fused_optimizer_state_save_load(tmp_path):
+    mod = _make_module(optimizer="adam", opt_params={"learning_rate": 0.01})
+    _run_steps(mod, n=2)
+    f = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(f)
+    mod2 = _make_module(optimizer="adam", opt_params={"learning_rate": 0.01})
+    _run_steps(mod2, n=1)
+    mod2.load_optimizer_states(f)
+    s1 = mod._updater.states
+    s2 = mod2._updater.states
+    assert set(s1) == set(s2)
+    for k in s1:
+        m1, v1 = s1[k]
+        m2, v2 = s2[k]
+        np.testing.assert_allclose(m1.asnumpy(), m2.asnumpy())
+        np.testing.assert_allclose(v1.asnumpy(), v2.asnumpy())
