@@ -199,6 +199,22 @@ class Executor:
         # NaiveEngine parity: MXNET_ENGINE_TYPE=NaiveEngine disables jit and
         # synchronizes after every call (threaded_engine.h:329-337 debugging).
         self._naive = env("MXNET_ENGINE_TYPE") == "NaiveEngine"
+        # graphs with Python-callback ops need host send/recv inside jit;
+        # on backends without it (some tunneled TPU platforms) fall back to
+        # eager execution so the graph still runs
+        if not self._naive and any(
+                n.op is not None and n.op.name in ("Custom", "_Native",
+                                                   "_NDArray")
+                for n in plan.nodes):
+            from .operator import host_callbacks_supported
+
+            if not host_callbacks_supported():
+                import logging
+
+                logging.warning(
+                    "graph contains Python-callback ops but backend lacks "
+                    "host-callback support under jit; executor runs eagerly")
+                self._naive = True
         # model parallelism: ctx-group → device placement compiled into the
         # step (group2ctx was previously accepted but silently ignored)
         self._placement = plan.placement_map(self._group2ctx)

@@ -251,100 +251,150 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
-class PrefetchingIter(DataIter):
-    """Threaded double-buffering over one or more iterators (reference
-    io.py:281, backed by dmlc::ThreadedIter in C++) — overlaps host batch
-    prep with device compute."""
+#: queue sentinel marking a source iterator's end of epoch
+_END_OF_EPOCH = object()
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+
+class PrefetchingIter(DataIter):
+    """Producer/consumer prefetch over one or more source iterators, so host
+    batch preparation overlaps device compute (the capability of the
+    reference's dmlc::ThreadedIter-backed PrefetchingIter, python/mxnet/
+    io.py:281 — rebuilt here on a bounded ``queue.Queue`` pipeline with
+    sentinel shutdown instead of event-pair handshakes).
+
+    Each source gets one worker thread pushing batches into a depth-bounded
+    queue; ``next()`` pops one batch per source and concatenates the
+    data/label lists.  ``prefetch_depth`` > 1 smooths bursty sources (the
+    event-pair scheme caps at double buffering).  ``reset`` tears the
+    pipeline down (poison via a stop flag + queue drain), resets the
+    sources, and restarts — epoch boundaries are rare so worker restart
+    costs nothing measurable.
+    """
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
         super().__init__()
-        if not isinstance(iters, list):
-            iters = [iters]
-        self.n_iter = len(iters)
-        assert self.n_iter > 0
-        self.iters = iters
+        self.iters = list(iters) if isinstance(iters, (list, tuple)) \
+            else [iters]
+        if not self.iters:
+            raise ValueError("PrefetchingIter needs at least one source")
+        self.n_iter = len(self.iters)
         self.rename_data = rename_data
         self.rename_label = rename_label
+        self._depth = max(1, int(prefetch_depth))
         self.batch_size = self.provide_data[0][1][0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
+        self.current_batch = None
+        self._queues = []
+        self._threads = []
+        self._stop = None
+        self._exhausted = False
+        self._spin_up()
 
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
+    # -- pipeline lifecycle -------------------------------------------------
+    def _spin_up(self):
+        import queue as _queue
+
+        self._stop = threading.Event()
+        self._queues = [_queue.Queue(maxsize=self._depth)
+                        for _ in range(self.n_iter)]
+        self._threads = []
+        for src, q in zip(self.iters, self._queues):
+            t = threading.Thread(target=self._produce,
+                                 args=(src, q, self._stop), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    @staticmethod
+    def _produce(src, q, stop):
+        while not stop.is_set():
+            try:
+                item = src.next()
+            except StopIteration:
+                item = _END_OF_EPOCH
+            except Exception as exc:  # surface source errors to the consumer
+                item = exc
+            while not stop.is_set():
                 try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
+                    q.put(item, timeout=0.05)
+                    break
+                except Exception:  # queue.Full — re-check stop
+                    continue
+            if item is _END_OF_EPOCH or isinstance(item, Exception):
+                return
 
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
-            for i in range(self.n_iter)]
-        for thread in self.prefetch_threads:
-            thread.start()
+    def _tear_down(self, wait=True):
+        if self._stop is None:
+            return
+        self._stop.set()
+        for q in self._queues:  # unblock producers stuck on a full queue
+            try:
+                while True:
+                    q.get_nowait()
+            except Exception:  # queue.Empty
+                pass
+        for t in self._threads:
+            # wait for workers to leave src.next() before the caller touches
+            # the (non-thread-safe) sources again; __del__ uses a bounded
+            # join since nothing observes the sources afterwards
+            t.join() if wait else t.join(timeout=1.0)
+        self._threads = []
+        self._queues = []
 
     def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
-        for thread in self.prefetch_threads:
-            thread.join(timeout=1.0)
+        try:
+            self._tear_down(wait=False)
+        except Exception:  # interpreter teardown: globals may be gone
+            pass
+
+    # -- DataIter surface ---------------------------------------------------
+    def _renamed(self, descs_per_iter, rename):
+        if rename is None:
+            return [d for descs in descs_per_iter for d in descs]
+        out = []
+        for mapping, descs in zip(rename, descs_per_iter):
+            for d in descs:
+                d = d if isinstance(d, DataDesc) else DataDesc(d[0], d[1])
+                out.append(DataDesc(mapping[d.name], d.shape, d.dtype))
+        return out
 
     @property
     def provide_data(self):
-        if self.rename_data is None:
-            return sum([i.provide_data for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(r[x[0]], x[1])
-                     for x in i.provide_data]
-                    for r, i in zip(self.rename_data, self.iters)], [])
+        return self._renamed([i.provide_data for i in self.iters],
+                             self.rename_data)
 
     @property
     def provide_label(self):
-        if self.rename_label is None:
-            return sum([i.provide_label for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(r[x[0]], x[1])
-                     for x in i.provide_label]
-                    for r, i in zip(self.rename_label, self.iters)], [])
+        return self._renamed([i.provide_label for i in self.iters],
+                             self.rename_label)
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
-        for i in self.iters:
-            i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._tear_down()
+        for src in self.iters:
+            src.reset()
+        self._exhausted = False
+        self._spin_up()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
-        if self.next_batch[0] is None:
-            for i in self.next_batch:
-                assert i is None, "Number of entry mismatches between iterators"
+        if self._exhausted:  # workers are gone; don't block on dead queues
             return False
-        for batch in self.next_batch:
-            assert batch.pad == self.next_batch[0].pad, \
-                "Number of entry mismatches between iterators"
+        parts = [q.get() for q in self._queues]
+        for p in parts:
+            if isinstance(p, Exception):
+                raise p
+        ended = [p is _END_OF_EPOCH for p in parts]
+        if any(ended):
+            if not all(ended):
+                raise RuntimeError(
+                    "prefetch sources ended at different batch counts")
+            self._exhausted = True
+            return False
+        first = parts[0]
+        if any(p.pad != first.pad for p in parts):
+            raise RuntimeError("prefetch sources disagree on batch padding")
         self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([batch.label for batch in self.next_batch], []),
-            self.next_batch[0].pad, self.next_batch[0].index)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+            [a for p in parts for a in p.data],
+            [a for p in parts for a in (p.label or [])],
+            first.pad, first.index)
         return True
 
     def next(self):

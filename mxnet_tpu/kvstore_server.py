@@ -13,6 +13,14 @@ ps-lite/ZeroMQ.
 Bootstrap parity with python/mxnet/kvstore_server.py:11-58: importing
 mxnet_tpu in a process whose ``DMLC_ROLE=server`` starts the server loop and
 exits when a stop command arrives.
+
+.. warning:: **Trust model** — same as the reference's ps-lite: the wire
+   format is unauthenticated length-prefixed pickles, so any peer that can
+   connect to the server port gets arbitrary code execution in the server
+   process.  Deploy only on a trusted, isolated network (the training
+   cluster's fabric).  The default bind address is 127.0.0.1; setting
+   ``DMLC_PS_ROOT_URI`` to a non-loopback address widens exposure to that
+   interface — do so only behind a network boundary you control.
 """
 from __future__ import annotations
 

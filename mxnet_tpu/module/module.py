@@ -352,6 +352,8 @@ class Module(BaseModule):
         if any(self._exec_group.grad_req.get(n) == "add"
                for n in self._param_names):
             return False
+        if self.inputs_need_grad:  # fused step differentiates params only
+            return False
         if self._exec_group._monitor_callback is not None:
             return False
         return True

@@ -18,10 +18,13 @@ Also provides the legacy ``PythonOp``/``NDArrayOp`` classes
 """
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Any, Dict, List
 
 import numpy as np
+
+from .base import MXNetError
 
 __all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_class",
            "PythonOp", "NDArrayOp", "NumpyOp"]
@@ -149,6 +152,14 @@ class _LRU(dict):
 
 _prop_cache: Dict[Any, CustomOpProp] = {}
 _op_cache: Dict[Any, CustomOp] = _LRU()
+# downstream caches key on this serial, not id(prop): after re-registration
+# CPython may recycle a freed prop's address, which would nondeterministically
+# serve a stale CustomOp built from the old class
+_prop_serial_counter = itertools.count()
+
+
+def _prop_key(prop) -> int:
+    return getattr(prop, "_mx_prop_serial", id(prop))
 
 
 def _user_kwargs(attrs: Dict[str, Any]) -> Dict[str, str]:
@@ -163,12 +174,13 @@ def _get_prop(attrs: Dict[str, Any]) -> CustomOpProp:
     prop = _prop_cache.get(key)
     if prop is None:
         prop = get_prop_class(op_type)(**kwargs)
+        prop._mx_prop_serial = next(_prop_serial_counter)
         _prop_cache[key] = prop
     return prop
 
 
 def _get_operator(prop: CustomOpProp, in_shapes, in_dtypes) -> CustomOp:
-    key = (id(prop), tuple(map(tuple, in_shapes)),
+    key = (_prop_key(prop), tuple(map(tuple, in_shapes)),
            tuple(str(d) for d in in_dtypes))
     op = _op_cache.get(key)
     if op is None:
@@ -220,7 +232,7 @@ _out_spec_cache: Dict[Any, Any] = _LRU()
 def _out_spec(prop, in_shapes, in_dtypes):
     """(out_shapes, out_dtypes) per (prop, shapes, dtypes) — computed once,
     not per training step."""
-    key = (id(prop), tuple(map(tuple, in_shapes)),
+    key = (_prop_key(prop), tuple(map(tuple, in_shapes)),
            tuple(str(d) for d in in_dtypes))
     spec = _out_spec_cache.get(key)
     if spec is None:
@@ -266,6 +278,44 @@ def _host_backward(prop, out_grad_np, main_np, out_np, aux_np):
     req = ["write"] * len(ig_nd)
     op.backward(req, og_nd, in_nd, out_nd, ig_nd, aux_nd)
     return tuple(g.asnumpy() for g in ig_nd)
+
+
+_host_cb_supported = None
+
+
+def host_callbacks_supported() -> bool:
+    """Whether the active JAX backend can run host callbacks inside jit
+    (some tunneled TPU platforms reject host send/recv).  Probed once with a
+    trivial pure_callback compile; Executor uses this to fall back to
+    unjitted execution for graphs containing Custom/_Native/_NDArray ops."""
+    global _host_cb_supported
+    if _host_cb_supported is None:
+        import jax
+
+        try:
+            spec = jax.ShapeDtypeStruct((), np.dtype(np.float32))
+            out = jax.jit(lambda: jax.pure_callback(
+                lambda: np.float32(1.0), spec))()
+            _host_cb_supported = float(out) == 1.0
+        except jax.errors.ConcretizationTypeError:
+            # probed from inside an active trace — cannot tell; leave the
+            # capability unknown and let the caller proceed optimistically
+            return True
+        except Exception:
+            _host_cb_supported = False
+    return _host_cb_supported
+
+
+def _custom_call_eager(prop, is_train, main, aux):
+    """Imperative path: direct host execution with no callback machinery —
+    works on every platform (the reference's kAsync engine op calling into
+    Python, custom-inl.h, without an engine)."""
+    import jax.numpy as jnp
+
+    main_np = [np.asarray(t) for t in main]
+    aux_np = [np.asarray(t) for t in aux]
+    res = _host_forward(prop, is_train, main_np, aux_np)
+    return tuple(jnp.asarray(r) for r in res)
 
 
 def _custom_call(prop, is_train, main, aux):
@@ -318,10 +368,24 @@ def _custom_call(prop, is_train, main, aux):
 
 def _custom_kernel(opctx, attrs, *tensors):
     """Registry kernel for the ``Custom`` op."""
+    import jax
+
     prop = _get_prop(attrs)
     n_args = len(prop.list_arguments())
     main = tensors[:n_args]
     aux = tensors[n_args:]
+    if not any(isinstance(t, jax.core.Tracer) for t in tensors):
+        # imperative mx.nd.Custom (or NaiveEngine executor): run on host
+        # directly — no pure_callback, so platforms without host send/recv
+        # support still work
+        return _custom_call_eager(prop, opctx.is_train, main, aux)
+    if _host_cb_supported is False:  # known-unsupported (probed eagerly)
+        raise MXNetError(
+            "This JAX backend does not support host callbacks inside jit, "
+            "so Custom ops cannot run in a compiled graph here. Run the "
+            "executor in NaiveEngine mode (MXNET_ENGINE_TYPE=NaiveEngine) "
+            "or on a backend with host-callback support; Executors detect "
+            "this automatically for graphs containing Custom ops.")
     outs, aux_new = _custom_call(prop, opctx.is_train, main, aux)
     return tuple(outs) + tuple(aux_new)
 

@@ -21,6 +21,7 @@ __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
 
 _KMAGIC = 0xced7230a
+_MAGIC_BYTES = struct.pack("<I", _KMAGIC)
 _LFLAG_BITS = 29
 _LENGTH_MASK = (1 << _LFLAG_BITS) - 1
 
@@ -65,40 +66,81 @@ class MXRecordIO:
         assert not self.writable
         self.handle.seek(pos)
 
-    def write(self, buf):
-        assert self.writable
-        if isinstance(buf, str):
-            buf = buf.encode("utf-8")
-        # one logical record, no multi-part continuation (parts only matter
-        # past 512MB payloads; reject instead of corrupting)
-        if len(buf) > _LENGTH_MASK:
-            raise ValueError("record too large for RecordIO format")
-        self.handle.write(struct.pack("<II", _KMAGIC, len(buf)))
-        self.handle.write(buf)
-        pad = (4 - (len(buf) % 4)) % 4
+    def _write_part(self, cflag, part):
+        self.handle.write(struct.pack(
+            "<II", _KMAGIC, (cflag << _LFLAG_BITS) | len(part)))
+        self.handle.write(part)
+        pad = (4 - (len(part) % 4)) % 4
         if pad:
             self.handle.write(b"\x00" * pad)
 
+    def write(self, buf):
+        """Write one logical record.  dmlc-core multi-part framing: if the
+        payload contains the magic word at a 4-byte-aligned offset, split
+        there (the magic itself is consumed as the part separator and
+        restored on read) with continue-flags 1=first / 2=middle / 3=last."""
+        assert self.writable
+        if isinstance(buf, str):
+            buf = buf.encode("utf-8")
+        if len(buf) > _LENGTH_MASK:
+            raise ValueError("record too large for RecordIO format")
+        # C-speed scan for aligned magic occurrences (bytes.find, not a
+        # per-offset Python loop — payloads are ~100KB JPEGs)
+        splits = []
+        pos = buf.find(_MAGIC_BYTES)
+        while pos != -1:
+            if pos % 4 == 0:
+                splits.append(pos)
+                pos = buf.find(_MAGIC_BYTES, pos + 4)
+            else:
+                pos = buf.find(_MAGIC_BYTES, pos + 1)
+        if not splits:
+            self._write_part(0, buf)
+            return
+        begin = 0
+        for n, i in enumerate(splits):
+            self._write_part(1 if n == 0 else 2, buf[begin:i])
+            begin = i + 4
+        self._write_part(3, buf[begin:])
+
     def read(self):
+        """Read one logical record, reassembling multi-part continuations
+        (continue-flag 1/2/3) with the separator magic restored between
+        parts — interchangeable with dmlc-core packs."""
         assert not self.writable
-        head = self.handle.read(8)
-        if len(head) < 8:
-            return None
-        magic, lrec = struct.unpack("<II", head)
-        if magic != _KMAGIC:
-            raise IOError("invalid RecordIO magic %#x in %s" % (magic, self.uri))
-        length = lrec & _LENGTH_MASK
-        cflag = lrec >> _LFLAG_BITS
-        buf = self.handle.read(length)
-        if len(buf) < length:
-            raise IOError("truncated record in %s" % self.uri)
-        pad = (4 - (length % 4)) % 4
-        if pad:
-            self.handle.read(pad)
-        if cflag not in (0,):
-            # continuation records (written only for >512MB payloads)
-            raise IOError("multi-part RecordIO records are not supported")
-        return buf
+        out = b""
+        expect_more = False
+        while True:
+            head = self.handle.read(8)
+            if len(head) < 8:
+                if expect_more:
+                    raise IOError("truncated multi-part record in %s" % self.uri)
+                return None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _KMAGIC:
+                raise IOError("invalid RecordIO magic %#x in %s"
+                              % (magic, self.uri))
+            length = lrec & _LENGTH_MASK
+            cflag = lrec >> _LFLAG_BITS
+            buf = self.handle.read(length)
+            if len(buf) < length:
+                raise IOError("truncated record in %s" % self.uri)
+            pad = (4 - (length % 4)) % 4
+            if pad:
+                self.handle.read(pad)
+            if cflag in (2, 3):
+                if not expect_more:
+                    raise IOError("unexpected continuation record in %s"
+                                  % self.uri)
+                out += _MAGIC_BYTES + buf
+            else:
+                if expect_more:
+                    raise IOError("unterminated multi-part record in %s"
+                                  % self.uri)
+                out = buf
+            if cflag in (0, 3):
+                return out
+            expect_more = True
 
 
 class MXIndexedRecordIO(MXRecordIO):

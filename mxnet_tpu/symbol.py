@@ -651,6 +651,14 @@ def load(fname: str) -> Symbol:
         return load_json(f.read())
 
 
+#: scope attrs that belong to the graph, not to any op's parameter struct
+#: (the reference AttrScope's sanctioned keys, python/mxnet/attribute.py);
+#: consulted only for allow_extra_attrs ops — a declared op param of the
+#: same name always stays a param
+_GRAPH_LEVEL_ATTRS = frozenset({
+    "ctx_group", "lr_mult", "wd_mult", "force_mirroring", "mirror_stage"})
+
+
 def load_json(json_str: str) -> Symbol:
     data = json.loads(json_str)
     jnodes = data["nodes"]
@@ -665,12 +673,18 @@ def load_json(json_str: str) -> Symbol:
             # set via AttrScope, e.g. lr_mult, or dunder graph attrs) passes
             # through as node attributes instead of raising — matches the
             # reference, where node attrs and op params share one string map.
-            param_attrs = {k: v for k, v in attr.items()
-                           if not k.startswith("__") and
-                           (k in op.params or op.allow_extra_attrs)}
-            graph_attrs = {k: v for k, v in attr.items()
-                           if k.startswith("__") or
-                           (k not in op.params and not op.allow_extra_attrs)}
+            # Graph-level scope attrs must never reach an allow_extra_attrs
+            # op (Custom) as constructor kwargs — a checkpoint of a Custom
+            # node built under AttrScope(ctx_group=...) would fail to load.
+            def _is_param(k):
+                if k.startswith("__"):
+                    return False
+                if k in op.params:  # declared params always win (e.g. the
+                    return True     # grad_scale of SoftmaxOutput)
+                return op.allow_extra_attrs and k not in _GRAPH_LEVEL_ATTRS
+
+            param_attrs = {k: v for k, v in attr.items() if _is_param(k)}
+            graph_attrs = {k: v for k, v in attr.items() if not _is_param(k)}
             parsed = op.parse_attrs(param_attrs)
             inputs = [(nodes[i[0]], i[1]) for i in jn["inputs"]]
             nodes.append(_Node(op, jn["name"], parsed, inputs, graph_attrs))

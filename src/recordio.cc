@@ -54,40 +54,61 @@ struct Prefetcher {
   std::string error;
 };
 
+// Reads one logical record, reassembling dmlc-core multi-part continuations
+// (continue-flag 1=first/2=middle/3=last; the separator magic consumed by
+// the writer's split is restored between parts).
 bool read_record(FILE* fp, Record* out, std::string* err) {
-  uint32_t head[2];
-  int64_t off =
+  out->data.clear();
+  bool expect_more = false;
+  for (;;) {
+    uint32_t head[2];
+    int64_t off =
 #ifdef _WIN32
-      _ftelli64(fp);
+        _ftelli64(fp);
 #else
-      ftello(fp);
+        ftello(fp);
 #endif
-  size_t n = fread(head, 1, sizeof(head), fp);
-  if (n == 0) return false;  // clean EOF
-  if (n < sizeof(head)) {
-    *err = "truncated record header";
-    return false;
+    size_t n = fread(head, 1, sizeof(head), fp);
+    if (n == 0) {
+      if (expect_more) *err = "truncated multi-part record";
+      return false;  // clean EOF (or truncation error set above)
+    }
+    if (n < sizeof(head)) {
+      *err = "truncated record header";
+      return false;
+    }
+    if (head[0] != kMagic) {
+      *err = "invalid RecordIO magic";
+      return false;
+    }
+    uint32_t lrec = head[1];
+    uint32_t length = lrec & kLengthMask;
+    uint32_t cflag = lrec >> kLFlagBits;
+    if (!expect_more) {
+      if (cflag == 2 || cflag == 3) {
+        *err = "unexpected continuation record";
+        return false;
+      }
+      out->offset = off;
+    } else {
+      if (cflag != 2 && cflag != 3) {
+        *err = "unterminated multi-part record";
+        return false;
+      }
+      const uint8_t* m = reinterpret_cast<const uint8_t*>(&kMagic);
+      out->data.insert(out->data.end(), m, m + 4);
+    }
+    size_t old = out->data.size();
+    out->data.resize(old + length);
+    if (length && fread(out->data.data() + old, 1, length, fp) < length) {
+      *err = "truncated record payload";
+      return false;
+    }
+    uint32_t pad = (4 - (length % 4)) % 4;
+    if (pad) fseek(fp, pad, SEEK_CUR);
+    if (cflag == 0 || cflag == 3) return true;
+    expect_more = true;
   }
-  if (head[0] != kMagic) {
-    *err = "invalid RecordIO magic";
-    return false;
-  }
-  uint32_t lrec = head[1];
-  uint32_t length = lrec & kLengthMask;
-  uint32_t cflag = lrec >> kLFlagBits;
-  if (cflag != 0) {
-    *err = "multi-part RecordIO records are not supported";
-    return false;
-  }
-  out->data.resize(length);
-  out->offset = off;
-  if (length && fread(out->data.data(), 1, length, fp) < length) {
-    *err = "truncated record payload";
-    return false;
-  }
-  uint32_t pad = (4 - (length % 4)) % 4;
-  if (pad) fseek(fp, pad, SEEK_CUR);
-  return true;
 }
 
 void producer_loop(Prefetcher* p) {
@@ -167,6 +188,22 @@ void* rio_writer_open(const char* path) {
   return w;
 }
 
+namespace {
+bool write_part(FILE* fp, uint32_t cflag, const uint8_t* buf, size_t len) {
+  uint32_t head[2] = {kMagic,
+                      (cflag << kLFlagBits) | static_cast<uint32_t>(len)};
+  if (fwrite(head, 1, sizeof(head), fp) < sizeof(head)) return false;
+  if (len && fwrite(buf, 1, len, fp) < len) return false;
+  uint32_t pad = (4 - (len % 4)) % 4;
+  static const uint8_t zeros[4] = {0, 0, 0, 0};
+  if (pad && fwrite(zeros, 1, pad, fp) < pad) return false;
+  return true;
+}
+}  // namespace
+
+// Writes one logical record, splitting at 4-byte-aligned occurrences of the
+// magic word in the payload (dmlc-core multi-part framing; the magic is
+// consumed as the part separator and restored by read_record).
 // Returns the byte offset the record was written at, or -1 on error.
 int64_t rio_write(void* handle, const uint8_t* buf, int64_t len) {
   auto* w = static_cast<Writer*>(handle);
@@ -177,14 +214,22 @@ int64_t rio_write(void* handle, const uint8_t* buf, int64_t len) {
 #else
       ftello(w->fp);
 #endif
-  uint32_t head[2] = {kMagic, static_cast<uint32_t>(len)};
-  if (fwrite(head, 1, sizeof(head), w->fp) < sizeof(head)) return -1;
-  if (len && fwrite(buf, 1, static_cast<size_t>(len), w->fp) <
-                 static_cast<size_t>(len))
-    return -1;
-  uint32_t pad = (4 - (len % 4)) % 4;
-  static const uint8_t zeros[4] = {0, 0, 0, 0};
-  if (pad && fwrite(zeros, 1, pad, w->fp) < pad) return -1;
+  size_t size = static_cast<size_t>(len);
+  std::vector<size_t> splits;
+  for (size_t i = 0; i + 4 <= size; i += 4) {
+    if (memcmp(buf + i, &kMagic, 4) == 0) splits.push_back(i);
+  }
+  if (splits.empty()) {
+    if (!write_part(w->fp, 0, buf, size)) return -1;
+    return off;
+  }
+  size_t begin = 0;
+  for (size_t n = 0; n < splits.size(); ++n) {
+    if (!write_part(w->fp, n == 0 ? 1 : 2, buf + begin, splits[n] - begin))
+      return -1;
+    begin = splits[n] + 4;
+  }
+  if (!write_part(w->fp, 3, buf + begin, size - begin)) return -1;
   return off;
 }
 

@@ -195,3 +195,38 @@ def test_custom_json_round_trip():
     exe.arg_dict["data"][:] = x
     out = exe.forward(is_train=False)[0].asnumpy()
     assert_almost_equal(out, x * 3.0, rtol=1e-5, atol=1e-6)
+
+
+def test_custom_op_attrscope_json_roundtrip(tmp_path):
+    # a Custom node built under AttrScope must survive save/load: scope
+    # attrs (ctx_group, lr_mult) are graph-level, not constructor kwargs
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="dev1", lr_mult="0.5"):
+        net = mx.sym.Custom(data, op_type="_test_sigmoid")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc")
+    path = str(tmp_path / "custom-attr.json")
+    net.save(path)
+    loaded = mx.sym.load(path)
+    assert loaded.list_arguments() == net.list_arguments()
+    # the scope attrs are preserved as node attrs after the round trip
+    attrs = loaded.attr_dict()
+    found = [v for k, v in attrs.items() if "ctx_group" in v]
+    assert any(v.get("ctx_group") == "dev1" for v in found)
+
+
+def test_custom_op_eager_no_callback(monkeypatch):
+    # imperative mx.nd.Custom must not depend on jit host-callback support
+    import mxnet_tpu.operator as op_mod
+
+    called = {}
+
+    def boom(*a, **k):
+        called["hit"] = True
+        raise AssertionError("pure_callback path used for eager Custom")
+
+    monkeypatch.setattr(op_mod, "_custom_call", boom)
+    x = mx.nd.array(np.array([[0.0, 1.0], [-1.0, 2.0]], np.float32))
+    out = mx.nd.Custom(x, op_type="_test_sigmoid")
+    expect = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+    assert "hit" not in called

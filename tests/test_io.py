@@ -81,6 +81,54 @@ def test_prefetching_iter():
     assert_almost_equal(batches[0].data[0], X[:4])
 
 
+def test_prefetching_iter_reset_and_depth():
+    X = np.arange(24, dtype=np.float32).reshape(12, 2)
+    base = mx.io.NDArrayIter(X, np.zeros(12, np.float32), batch_size=4)
+    it = mx.io.PrefetchingIter(base, prefetch_depth=4)
+    for _ in range(3):  # multiple epochs through reset
+        batches = list(it)
+        assert len(batches) == 3
+        assert_almost_equal(batches[0].data[0], X[:4])
+        it.reset()
+
+
+def test_prefetching_iter_multi_source_rename():
+    X1 = np.arange(16, dtype=np.float32).reshape(8, 2)
+    X2 = np.arange(24, dtype=np.float32).reshape(8, 3)
+    i1 = mx.io.NDArrayIter(X1, np.zeros(8, np.float32), batch_size=4)
+    i2 = mx.io.NDArrayIter(X2, None, batch_size=4)
+    it = mx.io.PrefetchingIter(
+        [i1, i2], rename_data=[{"data": "d1"}, {"data": "d2"}],
+        rename_label=[{"softmax_label": "l1"}, {}])
+    names = [d.name for d in it.provide_data]
+    assert names == ["d1", "d2"]
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (4, 2)
+    assert batches[0].data[1].shape == (4, 3)
+
+
+def test_prefetching_iter_source_error_propagates():
+    class Boom(mx.io.DataIter):
+        def __init__(self):
+            super().__init__(4)
+            self.provide_data = [mx.io.DataDesc("data", (4, 2))]
+            self.provide_label = []
+
+        def reset(self):
+            pass
+
+        def next(self):
+            raise ValueError("decode failed")
+
+    it = mx.io.PrefetchingIter(Boom())
+    try:
+        it.next()
+        assert False, "expected the source error to propagate"
+    except ValueError as e:
+        assert "decode failed" in str(e)
+
+
 def test_csv_iter():
     with tempfile.TemporaryDirectory() as d:
         data_path = os.path.join(d, "data.csv")
@@ -164,3 +212,36 @@ def test_mnist_iter_synthetic():
         b0 = batches[0]
         assert b0.data[0].shape[0] == 5
         np.testing.assert_allclose(b0.label[0].asnumpy(), labels[:5])
+
+
+def test_prefetching_iter_next_after_exhaustion():
+    # repeated next() past end-of-epoch must keep raising StopIteration
+    # (not deadlock on dead worker queues)
+    X = np.arange(8, dtype=np.float32).reshape(4, 2)
+    base = mx.io.NDArrayIter(X, np.zeros(4, np.float32), batch_size=2)
+    it = mx.io.PrefetchingIter(base)
+    assert len(list(it)) == 2
+    for _ in range(3):
+        try:
+            it.next()
+            assert False, "expected StopIteration"
+        except StopIteration:
+            pass
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_symbol_grad_scale_roundtrip(tmp_path):
+    # grad_scale is a declared op param and must survive save/load even
+    # though graph-level scope attrs are filtered for extra-attrs ops
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax", grad_scale=2.0)
+    p = str(tmp_path / "gs.json")
+    net.save(p)
+    loaded = mx.sym.load(p)
+    node_attrs = [n for n in __import__("json").loads(loaded.tojson())["nodes"]
+                  if n["name"] == "softmax"]
+    assert node_attrs and float(
+        node_attrs[0].get("attr", node_attrs[0].get("attrs", {}))
+        .get("grad_scale", 1.0)) == 2.0

@@ -105,3 +105,54 @@ def test_fallback_env_flag(tmp_path, monkeypatch):
     monkeypatch.setattr(native, "_TRIED", False)
     monkeypatch.delenv("MXNET_USE_NATIVE")
     assert native.get_lib() is not None
+
+
+def _magic_payloads():
+    import struct
+
+    magic = struct.pack("<I", 0xced7230a)
+    return [
+        magic,                                   # exactly the magic
+        b"abcd" + magic + b"efgh",               # aligned magic inside
+        b"ab" + magic + b"cdef",                 # unaligned magic (no split)
+        magic + magic + b"tail",                 # consecutive aligned magics
+        b"x" * 8 + magic,                        # magic at aligned end
+    ]
+
+
+def test_multipart_python_roundtrip(tmp_path):
+    path = str(tmp_path / "multi.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for p in _magic_payloads():
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for p in _magic_payloads():
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+
+
+def test_multipart_python_write_native_read(tmp_path):
+    path = str(tmp_path / "multi_pn.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for p in _magic_payloads():
+        w.write(p)
+    w.close()
+    r = native.NativeRecordReader(path)
+    for p in _magic_payloads():
+        assert bytes(r.read()) == p
+    assert r.read() is None
+
+
+def test_multipart_native_write_python_read(tmp_path):
+    path = str(tmp_path / "multi_np.rec")
+    w = native.NativeRecordWriter(path)
+    for p in _magic_payloads():
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for p in _magic_payloads():
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
