@@ -275,4 +275,8 @@ def create(name="local") -> KVStore:
         raise MXNetError("unknown KVStore type %s" % name)
     if name == "dist_async":
         return DistAsyncKVStore(name)
+    if name in ("dist_sync", "dist_device_sync", "dist"):
+        from .kvstore_dist import DistSyncKVStore
+
+        return DistSyncKVStore(name)
     return KVStore(name)

@@ -1,0 +1,142 @@
+"""``dist_sync`` / ``dist_device_sync`` — multi-host synchronous data
+parallelism over ``jax.distributed``.
+
+TPU-native redesign of the reference's parameter-server sync path
+(/root/reference/src/kvstore/kvstore_dist.h:28-318 worker client,
+kvstore_dist_server.h:136-200 per-key accumulation until ``NumWorkers()``
+pushes arrive).  There is no server here: every worker participates in a
+collective sum (XLA collectives over the ``jax.distributed`` coordination
+service — ICI/DCN on real pods), after which each worker applies the same
+deterministic update to its replica.  That reproduces the server's sync-sum
+semantics — pushed values for one key are summed across all workers before
+the optimizer sees them — without a host round-trip.
+
+Worker bring-up follows the reference's env-var contract
+(/root/reference/tools/launch.py + dmlc tracker): ``DMLC_NUM_WORKER``,
+``DMLC_WORKER_ID``, ``DMLC_PS_ROOT_URI``/``DMLC_PS_ROOT_PORT`` name the
+coordinator (the scheduler's analogue).  ``tools/launch.py`` in this repo
+sets them for local multi-process runs.
+
+Create the kvstore before running device computations: JAX's distributed
+runtime must initialize before the backends are first used.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+from .base import MXNetError
+from .kvstore import KVStore, _key_list, _val_list
+from .ndarray import NDArray
+from . import ndarray as nd
+
+__all__ = ["DistSyncKVStore", "ensure_distributed_initialized"]
+
+_initialized = False
+
+
+def ensure_distributed_initialized():
+    """Bring up ``jax.distributed`` from the DMLC env-var contract (no-op for
+    single-worker runs or when already connected)."""
+    global _initialized
+    if _initialized:
+        return
+    num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if num_workers <= 1:
+        _initialized = True
+        return
+    import jax
+
+    addr = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = os.environ.get("DMLC_PS_ROOT_PORT", "9360")
+    worker_id = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    try:
+        jax.distributed.initialize(
+            coordinator_address="%s:%s" % (addr, port),
+            num_processes=num_workers, process_id=worker_id)
+    except RuntimeError as e:
+        if "already" in str(e).lower():
+            logging.debug("jax.distributed already initialized: %s", e)
+        else:
+            raise MXNetError(
+                "dist_sync bring-up failed (create the kvstore before any "
+                "device computation; coordinator %s:%s): %s"
+                % (addr, port, e))
+    _initialized = True
+
+
+class DistSyncKVStore(KVStore):
+    """Synchronous multi-worker store: ``push`` sums values across ALL
+    workers (collective allreduce), then the updater — installed identically
+    on every worker by ``set_optimizer`` — applies the same update to each
+    replica.  Equivalent to the reference server's merge-until-NumWorkers
+    then update (kvstore_dist_server.h:164-200), minus the server."""
+
+    def __init__(self, kv_type="dist_sync"):
+        ensure_distributed_initialized()
+        super().__init__(kv_type)
+
+    # -- collective helpers ------------------------------------------------
+    def _allreduce_sum(self, arr):
+        """Sum an array across worker processes."""
+        import jax
+
+        if jax.process_count() == 1:
+            return arr
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(arr).sum(axis=0)
+
+    def _broadcast0(self, arr):
+        import jax
+
+        if jax.process_count() == 1:
+            return arr
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(arr)
+
+    # -- data plane --------------------------------------------------------
+    def init(self, key, value):
+        """Rank-0's value wins and is broadcast so every worker starts from
+        identical parameters (the reference inits only from rank 0,
+        kvstore_dist.h:64-82)."""
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, v in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError("duplicate init of key %s" % str(k))
+            src = v[0] if isinstance(v[0], NDArray) else nd.array(v[0])
+            self._store[k] = NDArray(self._broadcast0(src._data), src.context)
+
+    def push(self, key, value, priority=0):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("push to uninitialized key %s" % str(k))
+            acc = vlist[0]._data
+            for v in vlist[1:]:  # local device-group sum first
+                acc = acc + v._data
+            merged = NDArray(self._allreduce_sum(acc), vlist[0].context)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k]._set(merged._data)
+
+    # -- control plane -----------------------------------------------------
+    def _barrier(self):
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """The jax.distributed runtime fails fast on lost peers (the
+        coordination service aborts collectives), so a reachable store
+        implies zero dead nodes — the reference polls ps-lite instead
+        (kvstore_dist.h:151-160)."""
+        return 0

@@ -1,0 +1,71 @@
+"""Worker body for the dist_sync test (launched by tools/launch.py with 4
+processes).  Asserts the analytically-known sync-sum across workers — the
+repo's version of /root/reference/tests/nightly/dist_sync_kvstore.py:30-44,
+including a big key exercising larger payloads."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    kv = mx.kvstore.create("dist_sync")
+    nworker = kv.num_workers
+    rank = kv.rank
+    assert nworker == int(os.environ["DMLC_NUM_WORKER"]), \
+        (nworker, os.environ["DMLC_NUM_WORKER"])
+
+    shape = (3, 3)
+    big_shape = (100, 100)
+
+    # ---- raw sync-sum (no updater): every pull sees the all-worker sum
+    kv.init(3, mx.nd.ones(shape))
+    kv.init(99, mx.nd.ones(big_shape))
+    for it in range(3):
+        kv.push(3, mx.nd.ones(shape) * (rank + 1))
+        kv.push(99, mx.nd.ones(big_shape) * (rank + 2))
+        out = mx.nd.zeros(shape)
+        big = mx.nd.zeros(big_shape)
+        kv.pull(3, out=out)
+        kv.pull(99, out=big)
+        expect = sum(r + 1 for r in range(nworker))
+        expect_big = sum(r + 2 for r in range(nworker))
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.full(shape, expect, np.float32))
+        np.testing.assert_allclose(big.asnumpy(),
+                                   np.full(big_shape, expect_big, np.float32))
+
+    # ---- init broadcast: non-root inits are overridden by rank 0's value
+    kv.init(7, mx.nd.ones(shape) * (1.0 if rank == 0 else 555.0))
+    out = mx.nd.zeros(shape)
+    kv.pull(7, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(shape, np.float32))
+
+    # ---- updater path: identical deterministic update on every worker
+    kv.set_optimizer(mx.optimizer.Test(rescale_grad=1.0))
+    kv.init(11, mx.nd.zeros(shape))
+    for it in range(2):
+        kv.push(11, mx.nd.ones(shape) * (rank + 1))
+    out = mx.nd.zeros(shape)
+    kv.pull(11, out=out)
+    expect = 2 * sum(r + 1 for r in range(nworker))  # Test: w += sum(grad)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full(shape, expect, np.float32))
+
+    kv._barrier()
+    print("dist_sync_worker %d/%d OK" % (rank, nworker), flush=True)
+
+
+if __name__ == "__main__":
+    main()

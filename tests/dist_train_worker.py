@@ -1,0 +1,65 @@
+"""Distributed Module.fit smoke across 4 workers (reference:
+tests/nightly/dist_lenet.py) — each worker trains on its data shard through
+kvstore='dist_sync'; asserts the final parameters are bitwise identical on
+every worker and that training reduced the loss."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    kv = mx.kvstore.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+
+    rng = np.random.RandomState(7)  # same data everywhere; shard by rank
+    X = rng.randn(256, 16).astype(np.float32)
+    w = rng.randn(16)
+    y = (X @ w > 0).astype(np.float32)
+    shard = slice(rank * 256 // nworker, (rank + 1) * 256 // nworker)
+    train = mx.io.NDArrayIter(X[shard], y[shard], batch_size=16)
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mx.random.seed(0)
+    np.random.seed(0)
+    mod.fit(train, num_epoch=10, kvstore=kv, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            eval_metric=mx.metric.Accuracy())
+
+    args, _ = mod.get_params()
+    flat = np.concatenate([args[k].asnumpy().ravel() for k in sorted(args)])
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(multihost_utils.process_allgather(
+        jax.numpy.asarray(flat)))
+    for r in range(nworker):
+        np.testing.assert_array_equal(gathered[r], gathered[0])
+
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=16),
+                      mx.metric.Accuracy())
+    acc = score[0][1]
+    assert acc > 0.8, "dist training did not converge: acc=%s" % acc
+    print("dist_train_worker %d/%d OK acc=%.3f" % (rank, nworker, acc),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Multi-process dist_sync tests: tools/launch.py spawns 4 local worker
+processes that rendezvous via jax.distributed and assert sync-sum semantics
+(reference: tests/nightly/test_all.sh:37 running
+``launch.py -n 4 python dist_sync_kvstore.py``)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def _run_launcher(nworkers, script, timeout=240):
+    env = dict(os.environ)
+    env.pop("DMLC_ROLE", None)
+    # subprocesses must not inherit the 8-virtual-device flag: each worker
+    # is one process with one CPU device
+    env["XLA_FLAGS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", str(nworkers), sys.executable, script],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=timeout)
+
+
+def test_dist_sync_kvstore_4_workers():
+    res = _run_launcher(4, os.path.join(ROOT, "tests", "dist_sync_worker.py"))
+    ok_lines = [l for l in res.stdout.splitlines() if "OK" in l]
+    assert res.returncode == 0, (res.stdout[-3000:], res.stderr[-3000:])
+    assert len(ok_lines) == 4, res.stdout
+
+
+def test_dist_sync_in_process_single_worker():
+    # single-process fallback: dist_sync degrades to local semantics
+    import mxnet_tpu as mx
+    import numpy as np
+
+    kv = mx.kvstore.create("dist_sync")
+    assert kv.type == "dist_sync"
+    assert kv.num_workers == 1 and kv.rank == 0
+    kv.init(0, mx.nd.ones((2, 2)))
+    kv.push(0, mx.nd.ones((2, 2)) * 3)
+    out = mx.nd.zeros((2, 2))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 2), 3, np.float32))
+    assert kv.get_num_dead_node() == 0
+
+
+def test_dist_sync_module_training_4_workers():
+    res = _run_launcher(4, os.path.join(ROOT, "tests", "dist_train_worker.py"))
+    ok_lines = [l for l in res.stdout.splitlines() if "OK" in l]
+    assert res.returncode == 0, (res.stdout[-3000:], res.stderr[-3000:])
+    assert len(ok_lines) == 4, res.stdout

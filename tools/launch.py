@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Launch distributed training jobs as local processes.
+
+Parity surface: /root/reference/tools/launch.py (dmlc-core tracker) —
+``launch.py -n 4 python train.py ...`` spawns N worker processes with the
+DMLC env-var contract set; ``-s K`` additionally spawns K parameter-server
+processes (``dist_async``: the DMLC_ROLE=server import bootstrap in
+mxnet_tpu/kvstore_server.py takes over in those).  For ``dist_sync`` no
+servers are needed — workers rendezvous through the jax.distributed
+coordinator at DMLC_PS_ROOT_URI:DMLC_PS_ROOT_PORT (kvstore_dist.py).
+
+Only the ``local`` launcher is implemented: on TPU pods the platform
+scheduler (GKE/XPK) starts one process per host with the same env contract,
+so ssh/mpi/sge/yarn modes of the reference are intentionally out of scope.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job locally",
+        usage="launch.py [-h] -n NUM_WORKERS [-s NUM_SERVERS] command ...")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=0)
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local"])
+    parser.add_argument("--env", action="append", default=[],
+                        help="extra KEY=VALUE env entries for every process")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+
+    port = os.environ.get("DMLC_PS_ROOT_PORT") or str(_free_port())
+    base_env = dict(os.environ)
+    base_env.update({
+        "DMLC_PS_ROOT_URI": os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+        "DMLC_PS_ROOT_PORT": port,
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_NUM_SERVER": str(args.num_servers),
+    })
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        base_env[k] = v
+
+    procs = []
+    server_procs = []
+    try:
+        for i in range(args.num_servers):
+            env = dict(base_env)
+            env["DMLC_ROLE"] = "server"
+            env["DMLC_SERVER_ID"] = str(i)
+            server_procs.append(subprocess.Popen(args.command, env=env))
+        for i in range(args.num_workers):
+            env = dict(base_env)
+            env["DMLC_ROLE"] = "worker"
+            env["DMLC_WORKER_ID"] = str(i)
+            procs.append(subprocess.Popen(args.command, env=env))
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+    finally:
+        for p in procs + server_procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in server_procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
