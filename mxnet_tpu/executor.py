@@ -464,8 +464,18 @@ class Executor:
         key = ("fused", tuple(infos), id(optimizer), type(optimizer).__name__,
                hypers, float(optimizer.rescale_grad),
                float(optimizer.clip_gradient or 0.0))
+        first_build = key not in self._jit_cache
         fn = self._get_fused_step(key, tuple(infos), optimizer.pure_update,
                                   optimizer.needs_rng)
+        if first_build and not self._naive:
+            # introspection hook (compile-miss path only — zero per-step
+            # cost): abstract arg signature of the fused call, so
+            # tools/perf_probe.py can lower/compile the exact same program
+            # and read XLA cost analysis / HLO without re-deriving the
+            # arg packing
+            self._fused_introspect = (fn, jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                (diff_args, states, aux, other_args, rng, sc, opt_rng)))
         outs, new_aux, new_params, new_states = fn(
             diff_args, states, aux, other_args, rng, sc, opt_rng)
 

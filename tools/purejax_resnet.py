@@ -1,0 +1,147 @@
+"""Ceiling probe: hand-written ResNet-50 train step in pure JAX (no framework),
+NHWC bf16 compute, f32 master params, fused BN stats, SGD momentum, one
+donated jit.  Establishes what XLA can do on this chip so the framework's
+overhead is measurable against it.
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+STEPS = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+
+CFG = [(3, 256, 64), (4, 512, 128), (6, 1024, 256), (3, 2048, 512)]
+
+
+def conv_p(key, kh, kw, ci, co):
+    fan_in = kh * kw * ci
+    return jax.random.normal(key, (kh, kw, ci, co), jnp.float32) * np.sqrt(
+        2.0 / fan_in)
+
+
+def init_params(key):
+    p = {}
+    ks = iter(jax.random.split(key, 200))
+    p["stem"] = {"w": conv_p(next(ks), 7, 7, 3, 64),
+                 "g": jnp.ones((64,)), "b": jnp.zeros((64,))}
+    ci = 64
+    for si, (n_units, co, mid) in enumerate(CFG):
+        for ui in range(n_units):
+            blk = {}
+            blk["w1"] = conv_p(next(ks), 1, 1, ci, mid)
+            blk["g1"] = jnp.ones((mid,)); blk["b1"] = jnp.zeros((mid,))
+            blk["w2"] = conv_p(next(ks), 3, 3, mid, mid)
+            blk["g2"] = jnp.ones((mid,)); blk["b2"] = jnp.zeros((mid,))
+            blk["w3"] = conv_p(next(ks), 1, 1, mid, co)
+            blk["g3"] = jnp.ones((co,)); blk["b3"] = jnp.zeros((co,))
+            if ui == 0:
+                blk["wsc"] = conv_p(next(ks), 1, 1, ci, co)
+                blk["gsc"] = jnp.ones((co,)); blk["bsc"] = jnp.zeros((co,))
+            p[f"s{si}u{ui}"] = blk
+            ci = co
+    p["fc"] = {"w": jax.random.normal(next(ks), (2048, 1000)) * 0.01,
+               "b": jnp.zeros((1000,))}
+    return p
+
+
+DN = None
+
+
+def conv(x, w, stride=1):
+    global DN
+    return lax.conv_general_dilated(
+        x, w.astype(jnp.bfloat16), (stride, stride),
+        "SAME" if w.shape[0] > 1 else [(0, 0), (0, 0)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def bn(x, g, b):
+    mean = jnp.mean(x, axis=(0, 1, 2), dtype=jnp.float32)
+    meansq = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=(0, 1, 2))
+    var = jnp.maximum(meansq - jnp.square(mean), 0.0)
+    inv = lax.rsqrt(var + 2e-5)
+    scale = (g * inv).astype(x.dtype)
+    shift = (b - mean * inv * g).astype(x.dtype)
+    return x * scale + shift
+
+
+def block(x, p, stride, proj):
+    y = jax.nn.relu(bn(conv(x, p["w1"]), p["g1"], p["b1"]))
+    y = jax.nn.relu(bn(conv(y, p["w2"], stride), p["g2"], p["b2"]))
+    y = bn(conv(y, p["w3"]), p["g3"], p["b3"])
+    sc = bn(conv(x, p["wsc"], stride), p["gsc"], p["bsc"]) if proj else x
+    return jax.nn.relu(y + sc)
+
+
+def forward(params, x):
+    x = x.astype(jnp.bfloat16)
+    x = jax.nn.relu(bn(conv(x, params["stem"]["w"], 2),
+                       params["stem"]["g"], params["stem"]["b"]))
+    x = lax.reduce_window(x, np.array(-np.inf, x.dtype), lax.max,
+                          (1, 3, 3, 1), (1, 2, 2, 1),
+                          [(0, 0), (1, 1), (1, 1), (0, 0)])
+    for si, (n_units, co, mid) in enumerate(CFG):
+        for ui in range(n_units):
+            stride = 2 if (si > 0 and ui == 0) else 1
+            x = block(x, params[f"s{si}u{ui}"], stride, ui == 0)
+    x = jnp.mean(x, axis=(1, 2), dtype=jnp.float32)
+    return x @ params["fc"]["w"] + params["fc"]["b"]
+
+
+def loss_fn(params, x, y):
+    logits = forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+@jax.jit
+def init(key):
+    return init_params(key)
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    params = init(key)
+    mom = jax.tree_util.tree_map(jnp.zeros_like, params)
+    x = jax.random.normal(key, (B, 224, 224, 3), jnp.float32)
+    y = jax.random.randint(key, (B,), 0, 1000)
+
+    def step(params, mom, x, y):
+        g = jax.grad(loss_fn)(params, x, y)
+        new_mom = jax.tree_util.tree_map(lambda m, gg: 0.9 * m + gg, mom, g)
+        new_p = jax.tree_util.tree_map(lambda p, m: p - 0.1 * m, params,
+                                       new_mom)
+        return new_p, new_mom
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    params, mom = jstep(params, mom, x, y)
+    np.asarray(jax.tree_util.tree_leaves(params)[0])
+    # cost analysis
+    ab = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), (params, mom, x, y))
+    compiled = jstep.lower(*ab).compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    t0 = time.time()
+    for _ in range(STEPS):
+        params, mom = jstep(params, mom, x, y)
+    np.asarray(jax.tree_util.tree_leaves(params)[0])
+    dt = (time.time() - t0) / STEPS
+    model_flops = 3 * 4.089e9 * B
+    print(json.dumps({
+        "batch": B, "step_ms": round(dt * 1e3, 2),
+        "img_per_sec": round(B / dt, 1),
+        "mfu_model": round(model_flops / dt / 197e12, 4),
+        "xla_flops": ca.get("flops"),
+        "xla_gb": round(ca.get("bytes accessed", 0) / 1e9, 2),
+        "mfu_xla": round(ca.get("flops", 0) / dt / 197e12, 4)}))
+
+
+if __name__ == "__main__":
+    main()
