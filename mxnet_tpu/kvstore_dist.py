@@ -78,15 +78,51 @@ class DistSyncKVStore(KVStore):
         super().__init__(kv_type)
 
     # -- collective helpers ------------------------------------------------
+    _cmesh = None
+    _sum_fn = None
+
+    def _collective_mesh(self):
+        """1-axis mesh with ONE device per worker process — the lane the
+        eager push()'s allreduce rides (a compiled XLA collective over
+        ICI/DCN, not a host gather loop).  The fused Module path does not
+        come through here at all: its psum is compiled into the train step
+        over the full global mesh (module/executor_group.py)."""
+        if DistSyncKVStore._cmesh is None:
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh
+
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+            devs = [per_proc[p] for p in sorted(per_proc)]
+            DistSyncKVStore._cmesh = Mesh(np.asarray(devs), ("workers",))
+        return DistSyncKVStore._cmesh
+
     def _allreduce_sum(self, arr):
-        """Sum an array across worker processes."""
+        """Sum an array across worker processes as ONE compiled collective
+        (device-side; replaces the reference's ZPush/server-merge round trip,
+        kvstore_dist.h:211-228)."""
         import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         if jax.process_count() == 1:
             return arr
-        from jax.experimental import multihost_utils
-
-        return multihost_utils.process_allgather(arr).sum(axis=0)
+        mesh = self._collective_mesh()
+        me = jax.process_index()
+        local_dev = next(d for d in mesh.devices.flat
+                         if d.process_index == me)
+        v = jax.device_put(arr, local_dev)[None]
+        sharding = NamedSharding(mesh, P("workers"))
+        global_shape = (jax.process_count(),) + tuple(arr.shape)
+        stacked = jax.make_array_from_single_device_arrays(
+            global_shape, sharding, [v])
+        if DistSyncKVStore._sum_fn is None:
+            DistSyncKVStore._sum_fn = jax.jit(
+                lambda a: a.sum(axis=0),
+                out_shardings=NamedSharding(mesh, P()))
+        out = DistSyncKVStore._sum_fn(stacked)
+        return out.addressable_shards[0].data
 
     def _broadcast0(self, arr):
         import jax

@@ -35,7 +35,8 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=logging, fixed_param_names=None,
-                 grad_req="write", state_names=None, compute_dtype=None):
+                 grad_req="write", state_names=None, compute_dtype=None,
+                 dist_mesh=None):
         self.symbol = symbol
         self.contexts = contexts
         self.compute_dtype = compute_dtype
@@ -70,8 +71,23 @@ class DataParallelExecutorGroup:
         self._mesh = None
         self._data_sharding = None
         self._repl_sharding = None
-        if len(contexts) > 1:
-            import jax
+        self._multiprocess = False
+        import jax
+
+        if jax.process_count() > 1 and dist_mesh is not False:
+            # multi-host data parallelism: ONE global mesh over every device
+            # of every process; the fused step compiles the gradient psum
+            # over it (TPU-native replacement for the reference's
+            # ps-lite push/pull, src/kvstore/kvstore_dist.h:183-230 — the
+            # collective rides ICI/DCN inside the step instead of a host
+            # round-trip per key)
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            self._multiprocess = True
+            self._mesh = Mesh(np.asarray(jax.devices()), ("data",))
+            self._data_sharding = NamedSharding(self._mesh, P("data"))
+            self._repl_sharding = NamedSharding(self._mesh, P())
+        elif len(contexts) > 1:
             from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
             devices = [c.jax_device() for c in contexts]
@@ -117,7 +133,13 @@ class DataParallelExecutorGroup:
                     "all data must have the same batch size"
             else:
                 self.batch_size = batch_size
-                n = len(self.contexts)
+                if self._multiprocess:
+                    import jax
+
+                    # per-process batch; each process feeds its local devices
+                    n = jax.local_device_count()
+                else:
+                    n = len(self.contexts)
                 if batch_size % n != 0:
                     raise MXNetError(
                         "batch size %d is not divisible by the %d devices of "
@@ -206,6 +228,22 @@ class DataParallelExecutorGroup:
         if self._monitor_callback is not None:
             executor.set_monitor_callback(self._monitor_callback)
 
+    def _replicate(self, x):
+        """Place a process-local array as fully-replicated on the (possibly
+        multi-process) mesh."""
+        import jax
+
+        if not self._multiprocess:
+            return jax.device_put(x, self._repl_sharding)
+        if getattr(x, "is_fully_addressable", True):
+            host = np.asarray(x)
+        elif getattr(x, "is_fully_replicated", False):
+            host = np.asarray(x.addressable_shards[0].data)
+        else:
+            raise MXNetError("cannot replicate a cross-process sharded array")
+        return jax.make_array_from_callback(
+            host.shape, self._repl_sharding, lambda idx: host[idx])
+
     def _apply_shardings(self, executor):
         """Replicate params, shard batch inputs on the 'data' axis.  XLA's
         partitioner then emits the psum for gradient aggregation (the
@@ -214,13 +252,18 @@ class DataParallelExecutorGroup:
 
         batch_names = set(self.data_names) | set(self.label_names)
         for name, arr in executor.arg_dict.items():
-            sh = self._data_sharding if name in batch_names \
-                else self._repl_sharding
-            arr._set(jax.device_put(arr._data, sh))
+            if name in batch_names:
+                # batch entries are re-placed per step by _load_batch; on a
+                # multi-process mesh the bound placeholder stays local (its
+                # global shape differs from the bound local shape)
+                if not self._multiprocess:
+                    arr._set(jax.device_put(arr._data, self._data_sharding))
+            else:
+                arr._set(self._replicate(arr._data))
         for arr in executor.aux_dict.values():
-            arr._set(jax.device_put(arr._data, self._repl_sharding))
+            arr._set(self._replicate(arr._data))
         for arr in executor.grad_dict.values():
-            arr._set(jax.device_put(arr._data, self._repl_sharding))
+            arr._set(self._replicate(arr._data))
 
     def reshape(self, data_shapes, label_shapes):
         if data_shapes == self.data_shapes and \
@@ -265,18 +308,38 @@ class DataParallelExecutorGroup:
         arrays = list(zip(self.data_names, data_batch.data))
         if self.label_names and getattr(data_batch, "label", None):
             arrays += list(zip(self.label_names, data_batch.label))
+        expected = {d.name: tuple(d.shape)
+                    for d in self.data_shapes + self.label_shapes}
         for name, src in arrays:
             dst = executor.arg_dict[name]
-            data = src._data if isinstance(src, nd.NDArray) else \
-                nd.array(src)._data
-            if tuple(data.shape) != tuple(dst.shape):
-                raise MXNetError(
-                    "batch shape %s for %s does not match bound shape %s"
-                    % (tuple(data.shape), name, tuple(dst.shape)))
-            if data.dtype != dst.dtype:
-                data = data.astype(dst.dtype)
-            if self._data_sharding is not None:
-                data = jax.device_put(data, self._data_sharding)
+            if self._multiprocess:
+                # every process contributes its local batch as one shard of
+                # the GLOBAL batch (global batch = num_processes x local
+                # batch, split on the mesh 'data' axis); the traced step
+                # then runs SPMD over all hosts with the gradient psum
+                # compiled in.  Host numpy feeds the global array directly —
+                # no staging device round trip for numpy-backed iterators.
+                host = src.asnumpy() if isinstance(src, nd.NDArray) \
+                    else np.asarray(src)
+                if tuple(host.shape) != expected[name]:
+                    raise MXNetError(
+                        "batch shape %s for %s does not match bound shape %s"
+                        % (tuple(host.shape), name, expected[name]))
+                if host.dtype != dst.dtype:
+                    host = host.astype(dst.dtype)
+                data = jax.make_array_from_process_local_data(
+                    self._data_sharding, host)
+            else:
+                data = src._data if isinstance(src, nd.NDArray) else \
+                    nd.array(src)._data
+                if tuple(data.shape) != expected[name]:
+                    raise MXNetError(
+                        "batch shape %s for %s does not match bound shape %s"
+                        % (tuple(data.shape), name, expected[name]))
+                if data.dtype != dst.dtype:
+                    data = data.astype(dst.dtype)
+                if self._data_sharding is not None:
+                    data = jax.device_put(data, self._data_sharding)
             dst._set(data)
 
     def forward(self, data_batch, is_train=None):
@@ -301,12 +364,38 @@ class DataParallelExecutorGroup:
         self._load_batch(data_batch)
         self.execs[0].fused_step(optimizer, updater, self.param_names)
 
+    def _local_view(self, arr):
+        """Process-local slice of a batch-sharded global output (each worker
+        sees the rows it contributed — matching the reference, where a
+        worker's executor outputs cover only its own batch)."""
+        if not self._multiprocess:
+            return arr
+        import jax.numpy as jnp
+
+        x = arr._data
+        if getattr(x, "is_fully_addressable", True):
+            return arr
+        shards = sorted(x.addressable_shards, key=lambda s: s.index[0].start
+                        if s.index and s.index[0].start is not None else 0)
+        seen = set()
+        parts = []
+        for s in shards:
+            key = tuple((d.start, d.stop) for d in s.index if d is not None)
+            if key in seen:  # replicated output: one copy is enough
+                continue
+            seen.add(key)
+            parts.append(s.data)
+        local = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+        return nd.NDArray(local, self.contexts[0])
+
     def get_outputs(self, merge_multi_context=True):
-        return list(self.execs[0].outputs)
+        return [self._local_view(o) for o in self.execs[0].outputs]
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.inputs_need_grad
-        return [self.execs[0].grad_dict.get(name) for name in self.data_names]
+        return [self._local_view(g) if g is not None else None
+                for g in (self.execs[0].grad_dict.get(name)
+                          for name in self.data_names)]
 
     def update_metric(self, eval_metric, labels):
         eval_metric.update(labels, self.get_outputs())

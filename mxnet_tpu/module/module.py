@@ -30,8 +30,13 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None, compute_dtype=None):
+                 state_names=None, compute_dtype=None, dist_mesh=None):
         super().__init__(logger=logger)
+        # dist_mesh: None (auto) spans the executor mesh over every process
+        # when running under jax.distributed — the TPU-native dist_sync data
+        # plane; False forces a process-local module (e.g. a per-worker
+        # oracle/eval model inside a distributed job)
+        self._dist_mesh = dist_mesh
         # TPU-native mixed precision: compute in bf16, keep f32 master
         # params/grads/optimizer state (no reference equivalent — the
         # reference casts the symbol to fp16 instead)
@@ -242,7 +247,7 @@ class Module(BaseModule):
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group, self.logger,
             self._fixed_param_names, grad_req, state_names=self._state_names,
-            compute_dtype=self._compute_dtype)
+            compute_dtype=self._compute_dtype, dist_mesh=self._dist_mesh)
         self._total_exec_bytes = 0
 
         if shared_module is not None:
@@ -283,9 +288,33 @@ class Module(BaseModule):
 
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
+        if self._exec_group._multiprocess:
+            if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+                # global-mesh sync DP: the gradient all-reduce is compiled
+                # into the (fused) step over the multi-process mesh, and
+                # every worker applies the identical update to its replica —
+                # the kvstore degrades to a control-plane facade (init
+                # broadcast, barrier, rank), replacing the reference's
+                # server-side merge (kvstore_dist_server.h:164-200)
+                update_on_kvstore = False
+            elif kvstore and "dist" in kvstore.type:
+                # dist_async needs each worker's OWN gradient at the server;
+                # the mesh has already summed them — the two data planes
+                # cannot compose
+                raise MXNetError(
+                    "dist_async requires per-worker gradients: construct "
+                    "the Module with dist_mesh=False to train process-local "
+                    "replicas against the parameter server")
 
         batch_size = self._exec_group.batch_size
-        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+        if self._exec_group._multiprocess:
+            # gradients are summed over the GLOBAL batch by the compiled
+            # psum regardless of kvstore type, so the default grad scale
+            # must account for every process's shard
+            import jax
+
+            batch_size *= jax.process_count()
+        elif kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
             batch_size *= kvstore.num_workers
         rescale_grad = 1.0 / batch_size
 
@@ -320,6 +349,16 @@ class Module(BaseModule):
                                 arg_params=self._arg_params,
                                 param_names=self._param_names,
                                 update_on_kvstore=update_on_kvstore)
+            if not update_on_kvstore and "dist" in kvstore.type and \
+                    self._exec_group._multiprocess:
+                # pull the rank-0-broadcast init back so every replica
+                # starts identical (reference inits from rank 0 only,
+                # kvstore_dist.h:64-82); afterwards the kvstore data plane
+                # is out of the training loop
+                for idx, name in enumerate(self._param_names):
+                    kvstore.pull(idx, self._arg_params[name], priority=-idx)
+                self._exec_group.set_params(self._arg_params,
+                                            self._aux_params)
         if update_on_kvstore:
             kvstore.set_optimizer(self._optimizer)
         else:
@@ -345,7 +384,11 @@ class Module(BaseModule):
             return False
         if self._update_on_kvstore or self._updater is None:
             return False
-        if self._kvstore is not None and "dist" in self._kvstore.type:
+        if self._kvstore is not None and "dist" in self._kvstore.type \
+                and not self._exec_group._multiprocess:
+            # single-process dist (degenerate 1-worker run): keep the eager
+            # kvstore loop; with a real multi-process mesh the fused step
+            # carries the compiled psum and the kvstore is a facade
             return False
         if not type(self._optimizer).has_pure_update():
             return False
@@ -414,11 +457,17 @@ class Module(BaseModule):
                                       self._exec_group.grad_arrays,
                                       self._kvstore)
         else:
+            # on a multi-process mesh the gradients coming out of the
+            # executor are already globally summed (the psum is compiled
+            # into the backward), so the kvstore must NOT reduce them again
+            kv = self._kvstore
+            if kv is not None and self._exec_group._multiprocess:
+                kv = None
             _update_params(self._exec_group.param_arrays,
                            self._exec_group.grad_arrays,
                            updater=self._updater,
                            num_device=1,
-                           kvstore=self._kvstore)
+                           kvstore=kv)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

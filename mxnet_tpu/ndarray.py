@@ -118,7 +118,18 @@ class NDArray:
         self._data.block_until_ready()
 
     def asnumpy(self) -> np.ndarray:
-        return np.asarray(self._data)
+        x = self._data
+        # multi-process (global-mesh) arrays: a fully-replicated array has a
+        # complete local copy on every process — read that; a sharded global
+        # array has no local materialization and the caller should use the
+        # executor-group accessors that return the process-local slice
+        if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+            if getattr(x, "is_fully_replicated", False):
+                return np.asarray(x.addressable_shards[0].data)
+            raise MXNetError(
+                "array is sharded across processes; use the module/executor "
+                "accessors (get_outputs) for the process-local slice")
+        return np.asarray(x)
 
     def asscalar(self):
         if self.size != 1:

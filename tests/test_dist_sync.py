@@ -52,3 +52,12 @@ def test_dist_sync_module_training_4_workers():
     ok_lines = [l for l in res.stdout.splitlines() if "OK" in l]
     assert res.returncode == 0, (res.stdout[-3000:], res.stderr[-3000:])
     assert len(ok_lines) == 4, res.stdout
+
+
+def test_dist_fused_global_mesh_4_workers():
+    """The fused path: fwd+bwd+psum+update as ONE program over a mesh
+    spanning 4 processes, params matching a single-process oracle."""
+    res = _run_launcher(4, os.path.join(ROOT, "tests", "dist_fused_worker.py"))
+    ok_lines = [l for l in res.stdout.splitlines() if "OK" in l]
+    assert res.returncode == 0, (res.stdout[-3000:], res.stderr[-3000:])
+    assert len(ok_lines) == 4, res.stdout

@@ -1,0 +1,63 @@
+"""Collective-bandwidth microbenchmark for the dist data plane.
+
+TPU-native equivalent of the reference's kvstore throughput harness
+(/root/reference/tools/bandwidth/measure.py): instead of timing ps-lite
+push/pull round trips, it times the compiled allreduce the kvstore (and the
+fused step's psum) actually runs, across a sweep of tensor sizes.
+
+Run under the launcher, one process per worker:
+  python tools/launch.py -n 4 python tools/bandwidth.py [--sizes-mb 1,4,16,64]
+Prints one JSON line per size on rank 0 with effective algorithm bandwidth
+(2*(n-1)/n * bytes / time, the standard allreduce accounting).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes-mb", default="1,4,16,64")
+    ap.add_argument("--iters", type=int, default=10)
+    cli = ap.parse_args()
+
+    import numpy as np
+    import mxnet_tpu as mx
+
+    kv = mx.kvstore.create("dist_sync")
+    rank, n = kv.rank, kv.num_workers
+
+    for i, size_mb in enumerate(float(s) for s in cli.sizes_mb.split(",")):
+        nelem = int(size_mb * 1e6 / 4)
+        arr = mx.nd.ones((nelem,)) * (rank + 1)
+        kv.init(100 + i, mx.nd.zeros((nelem,)))
+        # warm up (compile)
+        kv.push(100 + i, arr)
+        out = mx.nd.zeros((nelem,))
+        kv.pull(100 + i, out=out)
+        out.asnumpy()
+        kv._barrier()
+        t0 = time.time()
+        for _ in range(cli.iters):
+            kv.push(100 + i, arr)
+        kv.pull(100 + i, out=out)
+        out.asnumpy()
+        dt = (time.time() - t0) / cli.iters
+        expect = (n * (n + 1)) // 2  # sum of (rank+1): init 0 + iters pushes
+        bus_bw = 2 * (n - 1) / n * size_mb * 1e6 / dt
+        if rank == 0:
+            print(json.dumps({
+                "metric": "allreduce_bandwidth", "size_mb": size_mb,
+                "workers": n, "time_ms": round(dt * 1e3, 3),
+                "bus_gb_s": round(bus_bw / 1e9, 3),
+                "unit": "GB/s"}), flush=True)
+    if rank == 0:
+        print("bandwidth OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
