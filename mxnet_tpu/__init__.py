@@ -58,3 +58,5 @@ from . import visualization as viz
 from . import profiler
 from . import image
 from . import models
+from . import contrib
+from .predictor import Predictor, load_exported

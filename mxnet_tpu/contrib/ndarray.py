@@ -1,0 +1,8 @@
+"""``mx.contrib.nd`` — imperative contrib ops under their reference short
+names (parity: /root/reference/python/mxnet/contrib/ndarray.py)."""
+from .. import ndarray as _ndarray
+from ._export import populate as _populate
+
+__all__ = []
+
+_populate(globals(), _ndarray, __all__)

@@ -1,0 +1,11 @@
+"""``mx.contrib.sym`` — contrib ops under their reference short names.
+
+Parity: /root/reference/python/mxnet/contrib/symbol.py (the reference
+codegen registers ``_contrib_Foo`` ops into the contrib module as ``Foo``).
+"""
+from .. import symbol as _symbol
+from ._export import populate as _populate
+
+__all__ = []
+
+_populate(globals(), _symbol, __all__)

@@ -27,6 +27,7 @@ import numpy as np
 from .base import MXNetError, env
 from .context import Context
 from .ops import OpContext
+from . import profiler as _prof
 from . import random as _random
 
 __all__ = ["Executor"]
@@ -476,8 +477,9 @@ class Executor:
             self._fused_introspect = (fn, jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
                 (diff_args, states, aux, other_args, rng, sc, opt_rng)))
-        outs, new_aux, new_params, new_states = fn(
-            diff_args, states, aux, other_args, rng, sc, opt_rng)
+        with _prof.Frame("Executor.fused_step", "exec"):
+            outs, new_aux, new_params, new_states = fn(
+                diff_args, states, aux, other_args, rng, sc, opt_rng)
 
         for name, idx, _, _ in infos:
             self.arg_dict[name]._set(new_params[name])
@@ -506,12 +508,14 @@ class Executor:
         aux = {k: v._data for k, v in self.aux_dict.items()}
         rng = _random.next_key() if self._plan.stochastic_nodes else None
         self._last_rng = rng
-        if self._monitor_callback is not None:
-            outs, new_aux, internals = self._get_fwd(is_train, True)(args, aux, rng)
-            for name, arr in internals.items():
-                self._monitor_callback(name, nd.NDArray(arr, self._ctx))
-        else:
-            outs, new_aux = self._get_fwd(is_train, False)(args, aux, rng)
+        with _prof.Frame("Executor.forward", "exec"):
+            if self._monitor_callback is not None:
+                outs, new_aux, internals = self._get_fwd(is_train, True)(
+                    args, aux, rng)
+                for name, arr in internals.items():
+                    self._monitor_callback(name, nd.NDArray(arr, self._ctx))
+            else:
+                outs, new_aux = self._get_fwd(is_train, False)(args, aux, rng)
         if is_train:
             for k, v in new_aux.items():
                 self.aux_dict[k]._set(v)
@@ -574,7 +578,9 @@ class Executor:
         old_grads = {k: self.grad_dict[k]._data for k in add_names
                      if k in self.grad_dict}
         fn = self._get_fwd_bwd(is_train, diff_names, add_names)
-        outs, new_aux, grads = fn(diff_args, other_args, aux, rng, ogs, old_grads)
+        with _prof.Frame("Executor.forward_backward", "exec"):
+            outs, new_aux, grads = fn(diff_args, other_args, aux, rng, ogs,
+                                      old_grads)
         for name in diff_names:
             if name in self.grad_dict:
                 self.grad_dict[name]._set(grads[name])
