@@ -33,6 +33,9 @@ def add_fit_args(parser: argparse.ArgumentParser):
     parser.add_argument("--disp-batches", type=int, default=20)
     parser.add_argument("--benchmark", type=int, default=0,
                         help="1 = synthetic data, report img/s only")
+    parser.add_argument("--test-io", type=int, default=0,
+                        help="1 = run the data iterator alone and report "
+                             "IO img/s (reference fit.py:106-116)")
     parser.add_argument("--num-examples", type=int, default=60000)
     parser.add_argument("--num-classes", type=int, default=10)
     parser.add_argument("--image-shape", type=str, default="1,28,28")
@@ -148,6 +151,26 @@ def fit(args, network, data_loader):
         return stats
     kv = mx.kvstore.create(args.kv_store)
     train, val = data_loader(args, kv)
+
+    if getattr(args, "test_io", 0):
+        # IO-only throughput: drain the train iterator, no compute in the
+        # loop (reference common/fit.py:106-116, the --test-io mode used to
+        # prove the decode pipeline can feed the chip)
+        tic = time.time()
+        n = 0
+        for epoch in range(args.num_epochs):
+            train.reset()
+            for batch in train:
+                batch.data[0].wait_to_read()
+                n += args.batch_size
+                if n % (args.batch_size * args.disp_batches) == 0:
+                    logging.info("io-test %d samples, %.1f img/s", n,
+                                 n / (time.time() - tic))
+        dt = time.time() - tic
+        stats = {"io_img_per_sec": n / dt, "samples": n}
+        print('{"metric": "io_img_per_sec", "value": %.2f}'
+              % stats["io_img_per_sec"])
+        return stats
 
     arg_params = aux_params = None
     begin_epoch = 0

@@ -41,14 +41,32 @@ def main():
     ap.add_argument("--num-classes", type=int, default=2)
     ap.add_argument("--num-examples", type=int, default=64)
     ap.add_argument("--model-prefix", type=str, default=None)
+    ap.add_argument("--data-train", type=str, default=None,
+                    help=".rec detection pack (im2rec multi-column list); "
+                         "without it, trains on synthetic boxes")
+    ap.add_argument("--data-shape", type=int, default=64)
+    ap.add_argument("--label-pad-width", type=int, default=8)
+    ap.add_argument("--rand-mirror", action="store_true")
+    ap.add_argument("--rand-crop", type=float, default=0.0)
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)-15s %(message)s")
 
-    data, label = synthetic_detection(args.num_examples,
-                                      num_classes=args.num_classes)
-    it = mx.io.NDArrayIter(data=data, label=label,
-                           batch_size=args.batch_size, label_name="label")
+    if args.data_train:
+        it = mx.image.ImageDetRecordIter(
+            path_imgrec=args.data_train,
+            data_shape=(3, args.data_shape, args.data_shape),
+            batch_size=args.batch_size,
+            label_pad_width=args.label_pad_width,
+            rand_mirror=args.rand_mirror, rand_crop=args.rand_crop,
+            std_r=255.0, std_g=255.0, std_b=255.0,
+            label_name="label")
+    else:
+        data, label = synthetic_detection(args.num_examples,
+                                          num_classes=args.num_classes)
+        it = mx.io.NDArrayIter(data=data, label=label,
+                               batch_size=args.batch_size,
+                               label_name="label")
     net = mx.models.get_ssd_train(num_classes=args.num_classes)
     mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
                         context=mx.current_context())

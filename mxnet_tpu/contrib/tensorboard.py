@@ -35,7 +35,8 @@ class LogMetricsCallback:
             self._jsonl = None
         except Exception:
             self.summary_writer = None
-            self._jsonl = os.path.join(logging_dir, "scalars.jsonl")
+            self._jsonl = open(
+                os.path.join(logging_dir, "scalars.jsonl"), "a")
 
     def __call__(self, param):
         if param.eval_metric is None:
@@ -46,14 +47,14 @@ class LogMetricsCallback:
             if self.prefix is not None:
                 name = "%s-%s" % (self.prefix, name)
             if self.summary_writer is not None:
+                # SummaryWriter flushes on its own cadence; no per-batch
+                # flush in the training hot path
                 self.summary_writer.add_scalar(name, value, self.step)
             else:
-                with open(self._jsonl, "a") as f:
-                    f.write(json.dumps({"tag": name, "value": float(value),
-                                        "step": self.step,
-                                        "wall_time": time.time()}) + "\n")
-        if self.summary_writer is not None:
-            self.summary_writer.flush()
+                self._jsonl.write(json.dumps(
+                    {"tag": name, "value": float(value), "step": self.step,
+                     "wall_time": time.time()}) + "\n")
+                self._jsonl.flush()
 
     @staticmethod
     def _name_values(metric):

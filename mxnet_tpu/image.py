@@ -48,6 +48,15 @@ def _as_np(img):
     return img.asnumpy() if isinstance(img, nd.NDArray) else np.asarray(img)
 
 
+def _like(src, arr):
+    """Type-preserving wrap: NDArray in -> NDArray out (reference API
+    parity); numpy in -> numpy out (the iterators' fast path — no per-image
+    device round trip through the eager array layer)."""
+    if isinstance(src, nd.NDArray):
+        return nd.array(arr, dtype=arr.dtype)
+    return arr
+
+
 def scale_down(src_size, size):
     """Scale (w, h) down to fit in src_size, preserving aspect."""
     w, h = size
@@ -67,14 +76,14 @@ def resize_short(src, size, interp=1):
     else:
         new_w, new_h = int(w * size / h), size
     out = image_backend.resize_image(arr, new_w, new_h, interp)
-    return nd.array(out, dtype=out.dtype)
+    return _like(src, out)
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
     arr = _as_np(src)[y0:y0 + h, x0:x0 + w]
     if size is not None and (w, h) != size:
         arr = image_backend.resize_image(arr, size[0], size[1], interp)
-    return nd.array(arr, dtype=arr.dtype)
+    return _like(src, arr)
 
 
 def random_crop(src, size, interp=1):
@@ -122,7 +131,13 @@ def color_normalize(src, mean, std=None):
     arr = arr - np.asarray(mean, np.float32)
     if std is not None:
         arr = arr / np.asarray(std, np.float32)
-    return nd.array(arr, dtype=np.float32)
+    return _like(src, arr.astype(np.float32))
+
+
+class _NpSafeAugList(list):
+    """Marker: every augmenter in this list is type-preserving (numpy in ->
+    numpy out), so iterators may run the chain GIL-cheaply on raw numpy.
+    User-supplied aug_list values keep the reference NDArray contract."""
 
 
 # -- augmenter callables (reference image.py returns lists of closures) -----
@@ -136,7 +151,7 @@ def ResizeAug(size, interp=1):
 def ForceResizeAug(size, interp=1):
     def aug(src):
         arr = _as_np(src)
-        return [nd.array(image_backend.resize_image(
+        return [_like(src, image_backend.resize_image(
             arr.astype(np.uint8), size[0], size[1], interp))]
     return aug
 
@@ -173,7 +188,7 @@ def RandomOrderAug(ts):
 def BrightnessJitterAug(brightness):
     def aug(src):
         alpha = 1.0 + pyrandom.uniform(-brightness, brightness)
-        return [nd.array(_as_np(src).astype(np.float32) * alpha)]
+        return [_like(src, _as_np(src).astype(np.float32) * alpha)]
     return aug
 
 
@@ -184,7 +199,7 @@ def ContrastJitterAug(contrast):
         alpha = 1.0 + pyrandom.uniform(-contrast, contrast)
         arr = _as_np(src).astype(np.float32)
         gray = (arr * coef).sum() * (3.0 / arr.size) * (1.0 - alpha)
-        return [nd.array(arr * alpha + gray)]
+        return [_like(src, arr * alpha + gray)]
     return aug
 
 
@@ -195,7 +210,7 @@ def SaturationJitterAug(saturation):
         alpha = 1.0 + pyrandom.uniform(-saturation, saturation)
         arr = _as_np(src).astype(np.float32)
         gray = (arr * coef).sum(axis=2, keepdims=True) * (1.0 - alpha)
-        return [nd.array(arr * alpha + gray)]
+        return [_like(src, arr * alpha + gray)]
     return aug
 
 
@@ -204,7 +219,7 @@ def LightingAug(alphastd, eigval, eigvec):
     def aug(src):
         alpha = np.random.normal(0, alphastd, size=(3,))
         rgb = np.dot(np.asarray(eigvec) * alpha, np.asarray(eigval))
-        return [nd.array(_as_np(src).astype(np.float32) + rgb)]
+        return [_like(src, _as_np(src).astype(np.float32) + rgb)]
     return aug
 
 
@@ -217,14 +232,14 @@ def ColorNormalizeAug(mean, std):
 def HorizontalFlipAug(p):
     def aug(src):
         if pyrandom.random() < p:
-            return [nd.array(_as_np(src)[:, ::-1].copy())]
+            return [_like(src, _as_np(src)[:, ::-1].copy())]
         return [src]
     return aug
 
 
 def CastAug():
     def aug(src):
-        return [nd.array(_as_np(src).astype(np.float32))]
+        return [_like(src, _as_np(src).astype(np.float32))]
     return aug
 
 
@@ -424,12 +439,21 @@ class ImageIter(mxio.DataIter):
         header, img = recordio.unpack(s)
         return header.label, img
 
-    def _decode_augment(self, buf):
-        """Decode one sample and run the augmenter chain → HWC float32."""
-        arr = nd.array(image_backend.decode_image(buf))
+    def _augment_arr(self, arr):
+        """Run the augmenter chain → HWC float32.  Built-in chains
+        (_NpSafeAugList) run numpy-to-numpy — no per-image device array;
+        user-supplied aug_lists get the reference NDArray contract."""
+        a = arr
+        if not isinstance(self.auglist, _NpSafeAugList) and \
+                not isinstance(a, nd.NDArray):
+            a = nd.array(a)
         for aug in self.auglist:
-            arr = aug(arr)[0]
-        return _as_np(arr).astype(np.float32)
+            a = aug(a)[0]
+        return _as_np(a).astype(np.float32)
+
+    def _decode_augment(self, buf):
+        """Decode one sample and run the augmenter chain."""
+        return self._augment_arr(image_backend.decode_image(buf))
 
     def _collect_raw(self):
         """Read up to batch_size raw samples; StopIteration if exhausted."""
@@ -480,18 +504,31 @@ class ImageIter(mxio.DataIter):
 
 
 class _ParallelImageIter(ImageIter):
-    """ImageIter with a thread pool decoding/augmenting each batch — the
-    TPU-side analogue of the reference's preprocess_threads OMP pool."""
+    """ImageIter with parallel decode — JPEGs go through the native libjpeg
+    thread pool (GIL-free, src/imgdecode.cc; the analogue of the reference's
+    preprocess_threads OMP decode, iter_image_recordio.cc:140-160), other
+    formats through PIL on Python threads.  Augmenters run on a thread pool
+    either way."""
 
     def __init__(self, *args, preprocess_threads=4, **kwargs):
         from concurrent.futures import ThreadPoolExecutor
 
         super(_ParallelImageIter, self).__init__(*args, **kwargs)
-        self._pool = ThreadPoolExecutor(max_workers=max(1, preprocess_threads))
+        self._nthreads = max(1, preprocess_threads)
+        self._pool = ThreadPoolExecutor(max_workers=self._nthreads)
 
     def _decode_batch(self, samples):
-        return list(self._pool.map(self._decode_augment,
-                                   [buf for _, buf in samples]))
+        bufs = [buf for _, buf in samples]
+        decoded = native.decode_jpeg_batch(bufs, nthreads=self._nthreads) \
+            if native.have_native() else [None] * len(bufs)
+
+        def finish(pair):
+            arr, buf = pair
+            if arr is None:  # non-JPEG or native unavailable: PIL path
+                return self._decode_augment(buf)
+            return self._augment_arr(arr)
+
+        return list(self._pool.map(finish, zip(decoded, bufs)))
 
 
 def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
@@ -518,6 +555,226 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, label_width=1,
         path_imgrec=path_imgrec, shuffle=shuffle, part_index=part_index,
         num_parts=num_parts, aug_list=aug_list, data_name=data_name,
         label_name=label_name, preprocess_threads=preprocess_threads,
+        **kwargs)
+    if prefetch_buffer:
+        return mxio.PrefetchingIter(inner)
+    return inner
+
+
+# ---------------------------------------------------------------------------
+# Detection pipeline — box-aware augmenters + ImageDetIter/ImageDetRecordIter
+# (reference: src/io/iter_image_det_recordio.cc:475-563 + the det augmenter
+# src/io/image_det_aug_default.cc).  Record label layout follows the dmlc
+# detection pack: [A, B, extra..., (B fields per object)*] where A is the
+# header width (>=2), B the per-object width (>=5: id, xmin, ymin, xmax,
+# ymax in [0,1] normalized coordinates).
+# ---------------------------------------------------------------------------
+
+
+def _det_parse_label(raw):
+    """Flat record label -> (N, B) object array (normalized coords)."""
+    raw = np.asarray(raw, np.float32).reshape(-1)
+    if raw.size < 2:
+        raise ValueError("detection label too short: %r" % (raw,))
+    a, b = int(raw[0]), int(raw[1])
+    if a < 2 or b < 5:
+        raise ValueError(
+            "bad detection header A=%d B=%d (need A>=2, B>=5)" % (a, b))
+    body = raw[a:]
+    n = body.size // b
+    return body[:n * b].reshape(n, b).copy()
+
+
+def _det_encode_label(objects, header_width=2):
+    """(N, B) objects -> flat record label (inverse of _det_parse_label)."""
+    objects = np.asarray(objects, np.float32)
+    b = objects.shape[1] if objects.ndim == 2 else 5
+    head = np.zeros(header_width, np.float32)
+    head[0], head[1] = header_width, b
+    return np.concatenate([head, objects.reshape(-1)])
+
+
+def DetHorizontalFlipAug(p):
+    """Mirror image AND boxes: x' = 1 - x (reference
+    image_det_aug_default.cc horizontal flip)."""
+    def aug(src, label):
+        if pyrandom.random() < p:
+            src = _as_np(src)[:, ::-1, :]
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+    return aug
+
+
+def DetRandomCropAug(min_object_covered=0.3, aspect_ratio_range=(0.75, 1.33),
+                     area_range=(0.3, 1.0), max_attempts=20):
+    """Sample a crop keeping objects whose centers stay inside; coordinates
+    are clipped and re-normalized to the crop (reference det crop sampler,
+    image_det_aug_default.cc crop logic)."""
+    def aug(src, label):
+        img = _as_np(src)
+        h, w = img.shape[:2]
+        for _ in range(max_attempts):
+            area = pyrandom.uniform(*area_range) * h * w
+            ratio = pyrandom.uniform(*aspect_ratio_range)
+            cw = int(round(np.sqrt(area * ratio)))
+            ch = int(round(np.sqrt(area / ratio)))
+            if cw > w or ch > h or cw < 1 or ch < 1:
+                continue
+            x0 = pyrandom.randint(0, w - cw)
+            y0 = pyrandom.randint(0, h - ch)
+            nx0, ny0 = x0 / w, y0 / h
+            nx1, ny1 = (x0 + cw) / w, (y0 + ch) / h
+            cx = (label[:, 1] + label[:, 3]) / 2
+            cy = (label[:, 2] + label[:, 4]) / 2
+            keep = (cx >= nx0) & (cx < nx1) & (cy >= ny0) & (cy < ny1)
+            if not keep.any():
+                continue
+            kept = label[keep].copy()
+            # clip to the crop, re-normalize
+            kept[:, 1] = np.clip((kept[:, 1] - nx0) / (nx1 - nx0), 0, 1)
+            kept[:, 3] = np.clip((kept[:, 3] - nx0) / (nx1 - nx0), 0, 1)
+            kept[:, 2] = np.clip((kept[:, 2] - ny0) / (ny1 - ny0), 0, 1)
+            kept[:, 4] = np.clip((kept[:, 4] - ny0) / (ny1 - ny0), 0, 1)
+            # min_object_covered: kept boxes must retain enough area
+            ow = np.maximum(kept[:, 3] - kept[:, 1], 0) * (nx1 - nx0)
+            oh = np.maximum(kept[:, 4] - kept[:, 2], 0) * (ny1 - ny0)
+            orig_w = np.maximum(label[keep, 3] - label[keep, 1], 1e-8)
+            orig_h = np.maximum(label[keep, 4] - label[keep, 2], 1e-8)
+            cov = (ow * oh) / (orig_w * orig_h)
+            if (cov >= min_object_covered).all():
+                return img[y0:y0 + ch, x0:x0 + cw, :], kept
+        return img, label
+    return aug
+
+
+def DetForceResizeAug(size, interp=1):
+    """Resize to exact (w, h); normalized box coords are scale-invariant."""
+    def aug(src, label):
+        return image_backend.resize_image(_as_np(src), size[0], size[1],
+                                          interp), label
+    return aug
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0.0, rand_mirror=False,
+                       mean=None, std=None, min_object_covered=0.3,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.3, 1.0), max_attempts=20):
+    """Standard detection augmenter chain (reference
+    image_det_aug_default.cc defaults): [crop] -> resize -> [flip] ->
+    normalize."""
+    augs = []
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                area_range, max_attempts)
+        p = float(rand_crop)
+
+        def maybe_crop(src, label, _crop=crop, _p=p):
+            if pyrandom.random() < _p:
+                return _crop(src, label)
+            return _as_np(src), label
+        augs.append(maybe_crop)
+    augs.append(DetForceResizeAug((data_shape[2], data_shape[1])))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    if mean is not None or std is not None:
+        mean = np.zeros(3, np.float32) if mean is None else mean
+        std = np.ones(3, np.float32) if std is None else std
+
+        def normalize(src, label, _m=mean, _s=std):
+            return (_as_np(src).astype(np.float32) - _m) / _s, label
+        augs.append(normalize)
+    return _NpSafeAugList(augs)
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: variable-object records -> fixed (batch,
+    label_pad_width, object_width) labels padded with -1 (the shape
+    MultiBoxTarget consumes).  Reference:
+    src/io/iter_image_det_recordio.cc:475-563."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 label_pad_width=8, object_width=5, aug_list=None,
+                 data_name="data", label_name="label", **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape)
+        self.label_pad_width = label_pad_width
+        self.object_width = object_width
+        super(ImageDetIter, self).__init__(
+            batch_size, data_shape, label_width=1, path_imgrec=path_imgrec,
+            aug_list=aug_list, data_name=data_name, label_name=label_name,
+            **kwargs)
+        self._provide_label = [mxio.DataDesc(
+            label_name, (batch_size, label_pad_width, object_width))]
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, h, w, c), np.float32)
+        batch_label = np.full(
+            (self.batch_size, self.label_pad_width, self.object_width),
+            -1.0, np.float32)
+        samples = self._collect_raw()
+        i = 0
+        for raw_label, buf in samples:
+            objects = _det_parse_label(raw_label)
+            img = image_backend.decode_image(buf)
+            for aug in self.auglist:
+                img, objects = aug(img, objects)
+            img = np.asarray(img, np.float32)
+            if img.shape[:2] != (h, w):
+                continue
+            if len(objects) > self.label_pad_width:
+                raise MXNetError(
+                    "record has %d objects but label_pad_width=%d — raise "
+                    "label_pad_width to at least the dataset maximum"
+                    % (len(objects), self.label_pad_width))
+            n = len(objects)
+            batch_data[i] = img
+            if n:
+                batch_label[i, :n] = objects[:n, :self.object_width]
+            i += 1
+        if i == 0 or (i < self.batch_size and
+                      self.last_batch_handle == "discard"):
+            raise StopIteration
+        for j in range(i, self.batch_size):
+            batch_data[j] = batch_data[i - 1]
+            batch_label[j] = batch_label[i - 1]
+        data_nchw = np.transpose(batch_data, (0, 3, 1, 2))
+        return mxio.DataBatch(data=[nd.array(data_nchw)],
+                              label=[nd.array(batch_label)],
+                              pad=self.batch_size - i)
+
+
+def ImageDetRecordIter(path_imgrec, data_shape, batch_size,
+                       label_pad_width=8, object_width=5, shuffle=False,
+                       rand_crop=0.0, rand_mirror=False,
+                       min_object_covered=0.3, max_attempts=20,
+                       area_range=(0.3, 1.0),
+                       mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                       std_r=1.0, std_g=1.0, std_b=1.0,
+                       part_index=0, num_parts=1, prefetch_buffer=1,
+                       data_name="data", label_name="label", **kwargs):
+    """Detection RecordIO iterator (reference ImageDetRecordIter,
+    src/io/iter_image_det_recordio.cc:563 registration): consumes
+    ``tools/im2rec.py``-packed detection records (vector labels), applies
+    box-aware augmentation, yields (data NCHW, label (B, pad, width))."""
+    mean = None
+    std = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b], np.float32)
+    if (std_r, std_g, std_b) != (1.0, 1.0, 1.0):
+        std = np.array([std_r, std_g, std_b], np.float32)
+    aug_list = CreateDetAugmenter(
+        data_shape, rand_crop=rand_crop, rand_mirror=rand_mirror,
+        mean=mean, std=std, min_object_covered=min_object_covered,
+        area_range=area_range, max_attempts=max_attempts)
+    inner = ImageDetIter(
+        batch_size, data_shape, path_imgrec=path_imgrec,
+        label_pad_width=label_pad_width, object_width=object_width,
+        shuffle=shuffle, part_index=part_index, num_parts=num_parts,
+        aug_list=aug_list, data_name=data_name, label_name=label_name,
         **kwargs)
     if prefetch_buffer:
         return mxio.PrefetchingIter(inner)

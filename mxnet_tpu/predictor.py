@@ -87,14 +87,19 @@ class Predictor:
                     raise MXNetError(
                         "param %s shape %s does not match inferred %s"
                         % (name, arg_params[name].shape, shape))
-                args[name] = nd.array(arg_params[name], self._ctx)
+                p = arg_params[name]
+                # reshape() passes live device NDArrays: share, don't copy
+                args[name] = p if isinstance(p, nd.NDArray) else \
+                    nd.array(p, self._ctx)
             else:
                 raise MXNetError("missing parameter %r" % name)
         aux = {}
         for name, shape in zip(aux_names, aux_shapes):
             if name not in aux_params:
                 raise MXNetError("missing auxiliary state %r" % name)
-            aux[name] = nd.array(aux_params[name], self._ctx)
+            a = aux_params[name]
+            aux[name] = a if isinstance(a, nd.NDArray) else \
+                nd.array(a, self._ctx)
 
         self._exec = symbol.bind(self._ctx, args, args_grad=None,
                                  grad_req="null", aux_states=aux)

@@ -1,0 +1,144 @@
+// Native JPEG batch decoder — the TPU-side answer to the reference's
+// OpenMP decode threads (/root/reference/src/io/iter_image_recordio.cc:140-160
+// decodes chunks in parallel with OpenCV).  Python/PIL decode holds the GIL
+// and tops out around ~300 img/s at 224^2; this decodes a whole batch on a
+// C++ thread pool via libjpeg, GIL-free, scaling with cores.
+//
+// C ABI (consumed by mxnet_tpu/native.py via ctypes):
+//   mxtpu_decode_jpeg_batch_alloc(bufs, lens, n, outs, ws, hs, nthreads)
+// allocates and fills RGB HWC 8-bit buffers (freed via mxtpu_free_many).
+
+#include <atomic>
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+};
+
+void on_error(j_common_ptr cinfo) {
+  ErrMgr* err = reinterpret_cast<ErrMgr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+void silent_output(j_common_ptr) {}
+
+// Decode one JPEG into out (RGB HWC, preallocated w*h*3). Returns 0 on ok.
+int decode_one(const uint8_t* buf, size_t len, uint8_t* out, int want_w,
+               int want_h) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = on_error;
+  jerr.pub.output_message = silent_output;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  if (static_cast<int>(cinfo.output_width) != want_w ||
+      static_cast<int>(cinfo.output_height) != want_h ||
+      cinfo.output_components != 3) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  const size_t stride = static_cast<size_t>(want_w) * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = out + static_cast<size_t>(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Peek dimensions without a full decode. Returns 0 on success.
+int mxtpu_jpeg_dims(const uint8_t* buf, size_t len, int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  ErrMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = on_error;
+  jerr.pub.output_message = silent_output;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  *w = static_cast<int>(cinfo.image_width);
+  *h = static_cast<int>(cinfo.image_height);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+// One-call variant: header parse + allocation + decode all happen on the
+// C++ thread pool (one GIL release for the whole batch).  outs[i] receives
+// a malloc'd RGB HWC buffer (caller frees via mxtpu_free_many) and
+// ws/hs[i] its dims; failed entries get outs[i]=NULL, ws/hs=0.
+int mxtpu_decode_jpeg_batch_alloc(const uint8_t** bufs, const size_t* lens,
+                                  int n, uint8_t** outs, int* ws, int* hs,
+                                  int nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  if (nthreads > n) nthreads = n;
+  std::atomic<int> next(0), ok(0);
+  auto worker = [&]() {
+    for (;;) {
+      int i = next.fetch_add(1);
+      if (i >= n) return;
+      outs[i] = nullptr;
+      ws[i] = hs[i] = 0;
+      int w = 0, h = 0;
+      if (mxtpu_jpeg_dims(bufs[i], lens[i], &w, &h) != 0 || w <= 0 ||
+          h <= 0) {
+        continue;
+      }
+      uint8_t* out = static_cast<uint8_t*>(
+          malloc(static_cast<size_t>(w) * h * 3));
+      if (!out) continue;
+      if (decode_one(bufs[i], lens[i], out, w, h) != 0) {
+        free(out);
+        continue;
+      }
+      outs[i] = out;
+      ws[i] = w;
+      hs[i] = h;
+      ok.fetch_add(1);
+    }
+  };
+  std::vector<std::thread> pool;
+  pool.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+  return ok.load();
+}
+
+void mxtpu_free_many(uint8_t** ptrs, int n) {
+  for (int i = 0; i < n; ++i) {
+    if (ptrs[i]) free(ptrs[i]);
+    ptrs[i] = nullptr;
+  }
+}
+
+}  // extern "C"

@@ -282,6 +282,6 @@ void rio_prefetch_close(void* handle) {
 void rio_free(uint8_t* buf) { free(buf); }
 
 // sanity/version probe for the ctypes loader
-int64_t rio_abi_version() { return 1; }
+int64_t rio_abi_version() { return 2; }  // 2: + imgdecode.cc jpeg batch API
 
 }  // extern "C"

@@ -1,0 +1,76 @@
+"""Real-data end-to-end gate: pack a small ImageNet-style .rec with im2rec,
+train ResNet through ImageRecordIter, and measure IO-only throughput via
+--test-io (reference: tests/nightly/test_all.sh:43-60 trains from .rec and
+gates on accuracy; --test-io per example/image-classification/README:245-268).
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import image_backend
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def _make_cls_pack(tmp_path, n=32, size=64, num_classes=2):
+    """Class-colored images packed to .rec via the im2rec CLI."""
+    rng = np.random.RandomState(0)
+    root = tmp_path / "imgs"
+    os.makedirs(root, exist_ok=True)
+    lines = []
+    for i in range(n):
+        cls = i % num_classes
+        img = (rng.rand(size, size, 3) * 40).astype(np.uint8)
+        img[:, :, cls] = np.minimum(img[:, :, cls] + 180, 255)
+        fname = "im%03d.png" % i
+        with open(root / fname, "wb") as f:
+            f.write(image_backend.encode_image(img, ".png"))
+        lines.append("%d\t%f\t%s" % (i, float(cls), fname))
+    prefix = str(tmp_path / "tinynet")
+    with open(prefix + ".lst", "w") as f:
+        f.write("\n".join(lines) + "\n")
+    subprocess.run([sys.executable, os.path.join(ROOT, "tools", "im2rec.py"),
+                    prefix, str(root), "--no-shuffle", "--pass-through"],
+                   check=True, capture_output=True)
+    return prefix + ".rec"
+
+
+def _run_driver(extra, timeout=900):
+    return subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "examples", "image_classification",
+                      "train_imagenet.py")] + extra,
+        capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_resnet_trains_from_rec(tmp_path):
+    rec = _make_cls_pack(tmp_path)
+    res = _run_driver([
+        "--data-train", rec, "--network", "resnet-18", "--num-classes", "2",
+        "--image-shape", "3,64,64", "--num-epochs", "3", "--batch-size", "8",
+        "--num-examples", "32", "--lr", "0.05", "--lr-step-epochs", "",
+        "--disp-batches", "2"])
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-3000:])
+    accs = [float(m.group(1)) for m in re.finditer(
+        r"Train-accuracy=([0-9.]+)", res.stdout + res.stderr)]
+    assert accs, "no Train-accuracy lines in driver output"
+    assert accs[-1] > 0.8, "ResNet did not learn from the .rec: %s" % accs
+
+
+def test_io_throughput_mode(tmp_path):
+    rec = _make_cls_pack(tmp_path)
+    res = _run_driver([
+        "--data-train", rec, "--test-io", "1", "--num-epochs", "2",
+        "--batch-size", "8", "--image-shape", "3,64,64",
+        "--num-classes", "2", "--disp-batches", "2"])
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-3000:])
+    line = [l for l in res.stdout.splitlines()
+            if l.startswith('{"metric": "io_img_per_sec"')][-1]
+    rate = json.loads(line)["value"]
+    assert rate > 0, line
