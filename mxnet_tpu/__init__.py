@@ -60,3 +60,4 @@ from . import image
 from . import models
 from . import contrib
 from .predictor import Predictor, load_exported
+from .ops import register_pallas_op, Param

@@ -181,6 +181,12 @@ class DistAsyncKVStore(KVStore):
         self._client = kvs.ServerClient(host, port)
         self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        # liveness: periodic heartbeat so the server can report dead peers
+        # and release stuck barriers (kvstore_dist.h:151-160 parity)
+        self._client.start_heartbeat(
+            self._rank,
+            interval=float(os.environ.get("MXNET_KVSTORE_HEARTBEAT_INTERVAL",
+                                          "5")))
 
     @property
     def rank(self) -> int:
@@ -222,6 +228,17 @@ class DistAsyncKVStore(KVStore):
                         data.sharding != o._data.sharding:
                     data = jax.device_put(data, o._data.sharding)
                 o._set(data)
+
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Count workers whose heartbeat went stale (reference
+        kvstore.get_num_dead_node over ps::Postoffice::GetDeadNodes,
+        kvstore_dist.h:151-160)."""
+        try:
+            return len(self._client.dead_nodes(float(timeout)))
+        except Exception:
+            # server unreachable: from this worker's view the service
+            # itself is dead
+            return 1
 
     def close(self):
         """Tear down the client socket and any in-process server."""

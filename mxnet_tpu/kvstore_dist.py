@@ -76,6 +76,7 @@ class DistSyncKVStore(KVStore):
     def __init__(self, kv_type="dist_sync"):
         ensure_distributed_initialized()
         super().__init__(kv_type)
+        self._start_heartbeat()
 
     # -- collective helpers ------------------------------------------------
     _cmesh = None
@@ -170,9 +171,107 @@ class DistSyncKVStore(KVStore):
 
             multihost_utils.sync_global_devices("kvstore_barrier")
 
+    # -- liveness ------------------------------------------------------
+    _hb_thread = None
+
+    @staticmethod
+    def _coord_client():
+        """The jax.distributed coordination-service client (the scheduler's
+        key-value store — the Postoffice analogue), or None."""
+        try:
+            from jax._src import distributed
+
+            return distributed.global_state.client
+        except Exception:
+            return None
+
+    def _start_heartbeat(self):
+        """Publish this worker's liveness into the coordination service so
+        peers can count dead nodes (reference: ps-lite node heartbeats
+        behind GetDeadNodes, kvstore_dist.h:151-160)."""
+        import threading
+        import time
+
+        if DistSyncKVStore._hb_thread is not None:
+            return
+        client = self._coord_client()
+        if client is None:
+            return
+        rank = self.rank
+        interval = float(os.environ.get("MXNET_KVSTORE_HEARTBEAT_INTERVAL",
+                                        "5"))
+        seq = [0]
+
+        def beat_once():
+            # publish a SEQUENCE NUMBER, not a wall-clock timestamp: hosts'
+            # clocks skew, but a stale-vs-advancing counter is judged
+            # entirely against the READER's monotonic clock
+            seq[0] += 1
+            client.key_value_set("mxtpu_hb/%d" % rank, str(seq[0]),
+                                 allow_overwrite=True)
+
+        def loop(stop):
+            while not stop.wait(interval):
+                try:
+                    beat_once()
+                except Exception:
+                    return
+
+        try:
+            beat_once()
+        except TypeError:
+            # older client signature without allow_overwrite: unsupported —
+            # disable heartbeats
+            return
+        except Exception:
+            return
+        stop = threading.Event()
+        t = threading.Thread(target=loop, args=(stop,), daemon=True)
+        t.start()
+        DistSyncKVStore._hb_thread = (t, stop)
+
+    _hb_seen: Dict[int, tuple] = {}
+
+    def _read_hb(self, client, r):
+        try:
+            return client.key_value_try_get("mxtpu_hb/%d" % r)
+        except AttributeError:
+            try:
+                return client.blocking_key_value_get("mxtpu_hb/%d" % r, 1000)
+            except Exception:
+                return None
+        except Exception:
+            return None
+
     def get_num_dead_node(self, node_id=0, timeout=60):
-        """The jax.distributed runtime fails fast on lost peers (the
-        coordination service aborts collectives), so a reachable store
-        implies zero dead nodes — the reference polls ps-lite instead
-        (kvstore_dist.h:151-160)."""
-        return 0
+        """Count workers whose heartbeat counter has stopped advancing for
+        ``timeout`` seconds of the CALLER's monotonic clock (no cross-host
+        wall-clock comparison, so clock skew cannot fabricate or mask
+        deaths).  The first observation of a rank establishes its baseline,
+        so detection needs two calls at least ``timeout`` apart — collectives
+        on this runtime additionally fail fast on lost peers.  Reference:
+        kvstore_dist.h:151-160."""
+        import time
+
+        import jax
+
+        if jax.process_count() == 1:
+            return 0
+        client = self._coord_client()
+        if client is None:
+            return 0
+        dead = 0
+        now = time.monotonic()
+        for r in range(self.num_workers):
+            if r == self.rank:
+                continue
+            raw = self._read_hb(client, r)
+            if raw is None:
+                continue  # never heartbeated: not tracked (launcher's job)
+            prev = DistSyncKVStore._hb_seen.get(r)
+            if prev is None or prev[0] != raw:
+                DistSyncKVStore._hb_seen[r] = (raw, now)
+                continue
+            if now - prev[1] > timeout:
+                dead += 1
+        return dead

@@ -30,6 +30,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -84,6 +85,10 @@ class KVStoreServer:
         self._barrier_cv = threading.Condition()
         self._merge: Dict[object, list] = {}
         self._stop = threading.Event()
+        # liveness: rank -> monotonic time of last heartbeat (reference:
+        # ps::Postoffice node tracking behind GetDeadNodes,
+        # kvstore_dist.h:151-160)
+        self._heartbeats: Dict[int, float] = {}
         server_self = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -151,9 +156,20 @@ class KVStoreServer:
             with self._lock:
                 self.updater = opt.get_updater(optimizer)
             return ("ok",)
+        if cmd == "heartbeat":
+            rank = int(msg[1])
+            with self._lock:
+                self._heartbeats[rank] = time.monotonic()
+            return ("ok",)
+        if cmd == "dead_nodes":
+            timeout_s = float(msg[1]) if len(msg) > 1 else 60.0
+            return ("ok", self._dead_nodes(timeout_s))
         if cmd == "barrier":
             timeout = float(os.environ.get("MXNET_KVSTORE_BARRIER_TIMEOUT",
                                            "600"))
+            hb_timeout = float(os.environ.get(
+                "MXNET_KVSTORE_DEAD_TIMEOUT", "60"))
+            deadline = time.monotonic() + timeout
             with self._barrier_cv:
                 gen = self._barrier_gen
                 self._barrier_count += 1
@@ -161,23 +177,45 @@ class KVStoreServer:
                     self._barrier_count = 0
                     self._barrier_gen += 1
                     self._barrier_cv.notify_all()
-                else:
+                    return ("ok",)
+                # wake periodically: a dead peer (stale heartbeat) releases
+                # the barrier with an error instead of hanging the job until
+                # the full timeout (reference: GetDeadNodes lets callers
+                # observe the failure; a dead worker otherwise wedges the
+                # server's merge-until-NumWorkers forever)
+                while True:
                     released = self._barrier_cv.wait_for(
-                        lambda: self._barrier_gen != gen, timeout=timeout)
-                    if not released:
-                        # undo this waiter's count so later barriers are not
-                        # permanently off by one, and report the failure
+                        lambda: self._barrier_gen != gen,
+                        timeout=min(1.0, max(deadline - time.monotonic(),
+                                             0.01)))
+                    if released:
+                        return ("ok",)
+                    dead = self._dead_nodes(hb_timeout)
+                    if dead:
+                        if self._barrier_gen == gen:
+                            self._barrier_count -= 1
+                        return ("err", "barrier aborted: dead workers %s"
+                                % dead)
+                    if time.monotonic() >= deadline:
                         if self._barrier_gen == gen:
                             self._barrier_count -= 1
                         return ("err",
                                 "barrier timed out after %.0fs" % timeout)
-            return ("ok",)
         if cmd == "stop":
             self._stop.set()
             threading.Thread(target=self._server.shutdown,
                              daemon=True).start()
             return ("ok",)
         return ("err", "unknown command %r" % (cmd,))
+
+    def _dead_nodes(self, timeout_s):
+        """Ranks whose last heartbeat is older than ``timeout_s`` (only
+        ranks that have ever heartbeated are tracked — a worker that never
+        connected is the launcher's problem, as in the reference)."""
+        now = time.monotonic()
+        with self._lock:
+            return sorted(r for r, t in self._heartbeats.items()
+                          if now - t > timeout_s)
 
     def _apply(self, key, grad):
         """Run the updater (reference DataHandle: updater_(key, recved,
@@ -211,8 +249,51 @@ class ServerClient:
     """Worker-side connection to a KVStoreServer (the ps::KVWorker role)."""
 
     def __init__(self, host, port):
+        self._addr = (host, port)
         self._sock = socket.create_connection((host, port), timeout=120)
         self._lock = threading.Lock()
+        self._hb_stop = None
+
+    def start_heartbeat(self, rank, interval=5.0):
+        """Publish liveness for ``rank`` every ``interval`` seconds on a
+        daemon thread (ps-lite node heartbeats; feeds the server's
+        dead-node tracking).  Uses its OWN connection: the main RPC socket
+        can sit inside a long blocking barrier() round trip, and a worker
+        waiting at a barrier must not go heartbeat-silent (that would make
+        the dead-peer barrier release see live stragglers as dead)."""
+        if self._hb_stop is not None:
+            return
+        self._hb_stop = threading.Event()
+        stop = self._hb_stop
+        addr = self._addr
+        self.heartbeat(rank)  # immediate first beat on the main socket
+
+        def loop():
+            try:
+                sock = socket.create_connection(addr, timeout=30)
+            except OSError:
+                return
+            try:
+                while not stop.wait(interval):
+                    _send_msg(sock, ("heartbeat", rank))
+                    reply = _recv_msg(sock)
+                    if reply[0] != "ok":
+                        return
+            except Exception:
+                return  # connection gone: the server will see us dead
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        threading.Thread(target=loop, daemon=True).start()
+
+    def heartbeat(self, rank):
+        self._rpc("heartbeat", rank)
+
+    def dead_nodes(self, timeout_s=60.0):
+        return self._rpc("dead_nodes", timeout_s)
 
     def _rpc(self, *msg):
         with self._lock:

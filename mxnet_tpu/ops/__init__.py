@@ -8,6 +8,7 @@ nn layers, sequence, optimizer updates, contrib.
 """
 from .registry import Op, OpContext, register, get_op, list_ops, registered_ops
 from .param import Param
+from .pallas_op import register_pallas_op
 
 from . import elemwise  # noqa: F401
 from . import matrix  # noqa: F401
@@ -24,4 +25,4 @@ from . import contrib_ops  # noqa: F401
 from . import attention  # noqa: F401
 
 __all__ = ["Op", "OpContext", "register", "get_op", "list_ops",
-           "registered_ops", "Param"]
+           "registered_ops", "Param", "register_pallas_op"]

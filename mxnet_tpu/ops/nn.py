@@ -101,11 +101,18 @@ def _fc_infer(attrs, in_shapes):
     nh = attrs["num_hidden"]
     if d is None:
         return in_shapes, [None], []
-    in_dim = int(np.prod(d[1:])) if len(d) > 1 else 1
+    if attrs.get("flatten", True) or len(d) <= 2:
+        in_dim = int(np.prod(d[1:])) if len(d) > 1 else 1
+        out = (d[0], nh)
+    else:
+        # flatten=False: FC applies to the trailing axis only (reference
+        # fully_connected-inl.h flatten param)
+        in_dim = d[-1]
+        out = tuple(d[:-1]) + (nh,)
     shapes = [d, (nh, in_dim)]
     if not attrs.get("no_bias"):
         shapes.append((nh,))
-    return shapes, [(d[0], nh)], []
+    return shapes, [out], []
 
 
 @register("FullyConnected", inputs=_fc_inputs,
@@ -113,7 +120,7 @@ def _fc_infer(attrs, in_shapes):
                   "flatten": Param(bool, True)},
           infer_shape=_fc_infer, hint="fullyconnected")
 def _fully_connected(opctx, attrs, data, weight, *rest):
-    if data.ndim > 2:
+    if data.ndim > 2 and attrs.get("flatten", True):
         data = data.reshape(data.shape[0], -1)
     out = jnp.dot(data, weight.T)
     if rest:

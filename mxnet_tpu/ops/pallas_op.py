@@ -1,0 +1,103 @@
+"""Public user-kernel escape hatch — ``mx.register_pallas_op``.
+
+MXRtc parity, TPU-style: where the reference lets users compile raw CUDA
+strings at runtime and call them as ops (/root/reference/src/common/
+mxrtc.cc:117-135, ``mx.rtc``), here users hand in a JAX/Pallas function and
+get a first-class registered op back — visible as ``mx.sym.<name>`` /
+``mx.nd.<name>``, usable in symbols, executors, Module training, and the
+fused step, with an optional custom gradient.
+
+    def kernel(attrs, x):          # attrs: parsed op params
+        return pl.pallas_call(...)(x)
+
+    mx.register_pallas_op("my_op", kernel,
+                          params={"alpha": Param(float, 1.0)})
+
+For training through a non-differentiable ``pallas_call``, supply ``bwd``
+(and optionally ``fwd`` for residual control) with ``jax.custom_vjp``
+semantics:
+
+    def fwd(attrs, *inputs):   -> (output, residuals)
+    def bwd(attrs, residuals, cotangent) -> tuple of input cotangents
+
+``_contrib_FlashAttention`` (ops/attention.py) is registered through this
+exact mechanism.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+__all__ = ["register_pallas_op"]
+
+
+def register_pallas_op(name: str, fn: Callable, bwd: Optional[Callable] = None,
+                       fwd: Optional[Callable] = None, inputs=("data",),
+                       params=None, infer_shape=None, num_outputs=1,
+                       aliases=(), hint=None):
+    """Register ``fn(attrs, *arrays)`` as op ``name``.
+
+    Parameters
+    ----------
+    fn : the kernel wrapper — typically closes over a ``pl.pallas_call``.
+        Receives the parsed attr dict first, then the input arrays.
+    bwd : optional custom gradient, ``bwd(attrs, residuals, cotangents) ->
+        input cotangents`` (cotangents is the bare output cotangent for
+        single-output ops).  Without it the op differentiates through
+        ``fn`` itself (fine for plain-jnp fns; pallas_call needs ``bwd``).
+    fwd : optional ``fwd(attrs, *arrays) -> (out, residuals)``; defaults to
+        saving the inputs as residuals.
+    inputs / params / infer_shape / num_outputs / aliases : the registry
+        surface, identical to internal op registration (ops/registry.py).
+    """
+    from .registry import register
+
+    if fwd is not None and bwd is None:
+        raise ValueError(
+            "register_pallas_op: fwd without bwd has no effect — supply "
+            "bwd (custom gradient) or drop fwd")
+
+    decorator = register(name, inputs=tuple(inputs), params=dict(params or {}),
+                         infer_shape=infer_shape, num_outputs=num_outputs,
+                         aliases=tuple(aliases), hint=hint or name.lower())
+
+    if bwd is None:
+        def _op(opctx, attrs, *arrays):
+            return fn(attrs, *arrays)
+    else:
+        def _op(opctx, attrs, *arrays):
+            import jax
+
+            @jax.custom_vjp
+            def run(*arrs):
+                return fn(attrs, *arrs)
+
+            def _fwd(*arrs):
+                if fwd is not None:
+                    return fwd(attrs, *arrs)
+                return run(*arrs), arrs
+
+            def _bwd(res, ct):
+                out = bwd(attrs, res, ct)
+                return tuple(out)
+
+            run.defvjp(_fwd, _bwd)
+            return run(*arrays)
+
+    _op.__name__ = "pallas_op_%s" % name
+    decorator(_op)
+
+    # late registration: ops registered after package import also appear on
+    # the already-generated mx.sym / mx.nd surfaces.  During initial package
+    # import those modules regenerate after all ops load, so only refresh
+    # ones that are fully imported (avoids a circular import from ops that
+    # register at import time, like _contrib_FlashAttention).
+    import sys
+
+    pkg = __package__.rsplit(".", 1)[0]
+    sym_mod = sys.modules.get(pkg + ".symbol")
+    if sym_mod is not None and hasattr(sym_mod, "_init_symbol_module"):
+        sym_mod._init_symbol_module()
+    nd_mod = sys.modules.get(pkg + ".ndarray")
+    if nd_mod is not None and hasattr(nd_mod, "_init_ops"):
+        nd_mod._init_ops()
+    return _op

@@ -79,3 +79,73 @@ def test_flash_in_ulysses():
                                      attn_fn=attn)
     assert_almost_equal(np.asarray(out), np.asarray(ref),
                         rtol=1e-5, atol=1e-5)
+
+
+def test_flash_backward_kernel_vs_dense_oracle():
+    """The Pallas backward kernels (dQ + dK/dV, flash-v2 schedule) must
+    match the dense vjp across causal/non-causal, rectangular seqs, and
+    bf16 — and they ARE the training path (custom_vjp uses the kernels,
+    not the dense oracle)."""
+    import jax
+    import jax.numpy as jnp
+
+    np.random.seed(0)
+    configs = [
+        (2, 16, 16, 2, 8, True, jnp.float32, 2e-4),
+        (1, 32, 16, 1, 8, False, jnp.float32, 2e-4),
+        (2, 24, 24, 2, 4, True, jnp.float32, 2e-4),
+        (1, 16, 16, 2, 8, True, jnp.bfloat16, 2e-2),
+    ]
+    for b, s, sk, h, d, causal, dt, tol in configs:
+        q = jnp.asarray(np.random.randn(b, s, h, d).astype("f") * 0.4, dt)
+        k = jnp.asarray(np.random.randn(b, sk, h, d).astype("f") * 0.4, dt)
+        v = jnp.asarray(np.random.randn(b, sk, h, d).astype("f") * 0.4, dt)
+
+        def f(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=causal, block_q=8,
+                block_k=8).astype(jnp.float32) ** 2)
+
+        def g(q, k, v):
+            return jnp.sum(_reference_attention(
+                q, k, v, causal, 1.0 / np.sqrt(d)).astype(jnp.float32) ** 2)
+
+        gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gg = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+        for name, a, bb in zip("qkv", gf, gg):
+            err = float(jnp.max(jnp.abs(
+                a.astype(jnp.float32) - bb.astype(jnp.float32))))
+            ref = float(jnp.max(jnp.abs(bb.astype(jnp.float32)))) + 1e-6
+            assert err / ref < tol, (name, causal, dt, err / ref)
+
+
+def test_flash_long_sequence_train_step():
+    """Long-sequence training step through the kernel path: K/V stream
+    block-by-block (nothing whole-sequence is staged in VMEM), so seq
+    length is HBM-bound.  16k+ on the TPU chip; a shorter structural run
+    on the CPU interpreter."""
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = jax.default_backend() == "tpu"
+    s = 16384 if on_tpu else 256
+    b, h, d = 1, 2, 64
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, s, h, d), dt) * 0.2
+    k = jax.random.normal(key, (b, s, h, d), dt) * 0.2
+    v = jax.random.normal(key, (b, s, h, d), dt) * 0.2
+    w = jnp.eye(d, dtype=dt)
+
+    def loss(w, q, k, v):
+        o = flash_attention(q @ w, k, v, causal=True)
+        return jnp.mean(o.astype(jnp.float32) ** 2)
+
+    step = jax.jit(jax.value_and_grad(loss))
+    val, grad = step(w, q, k, v)
+    gnorm = float(jnp.linalg.norm(grad.astype(jnp.float32)))
+    assert np.isfinite(float(val)) and gnorm > 0
+    # normalized step so the loss moves resolvably in f32
+    val2, _ = step(w - (0.05 / gnorm) * grad.astype(dt), q, k, v)
+    assert np.isfinite(float(val2))
+    assert float(val2) < float(val)

@@ -1,0 +1,76 @@
+"""Failure-detection tests: heartbeat-backed dead-node reporting and barrier
+release when a worker dies (reference: ps::Postoffice::GetDeadNodes surfaced
+as kvstore.get_num_dead_node, /root/reference/src/kvstore/kvstore_dist.h:
+151-160; without it a dead worker hangs the sync merge forever)."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore_server as kvs
+
+
+def test_dead_node_detection_via_heartbeats():
+    srv = kvs.start_server(num_workers=2, sync_mode=False)
+    host, port = srv.addr
+    try:
+        alive = kvs.ServerClient(host, port)
+        doomed = kvs.ServerClient(host, port)
+        alive.start_heartbeat(0, interval=0.1)
+        doomed.heartbeat(1)  # beats once, then "dies" (no more heartbeats)
+        time.sleep(0.5)
+        assert alive.dead_nodes(timeout_s=10.0) == []
+        dead = alive.dead_nodes(timeout_s=0.3)
+        assert dead == [1], dead
+        alive.close()
+        doomed.close()
+    finally:
+        srv.stop()
+
+
+def test_barrier_released_by_dead_worker(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_BARRIER_TIMEOUT", "30")
+    monkeypatch.setenv("MXNET_KVSTORE_DEAD_TIMEOUT", "0.5")
+    srv = kvs.start_server(num_workers=2, sync_mode=True)
+    host, port = srv.addr
+    try:
+        survivor = kvs.ServerClient(host, port)
+        survivor.start_heartbeat(0, interval=0.1)
+        dead = kvs.ServerClient(host, port)
+        dead.heartbeat(1)
+        dead.close()  # worker 1 dies before reaching the barrier
+
+        t0 = time.time()
+        with pytest.raises(mx.base.MXNetError, match="dead workers"):
+            survivor.barrier()
+        # released by deadness detection, NOT the 30s barrier timeout
+        assert time.time() - t0 < 10
+        survivor.close()
+    finally:
+        srv.stop()
+
+
+def test_dist_async_kvstore_reports_dead_nodes(monkeypatch):
+    monkeypatch.delenv("DMLC_PS_ROOT_URI", raising=False)
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.1")
+    kv = mx.kvstore.create("dist_async")
+    try:
+        assert kv.get_num_dead_node(timeout=30) == 0
+        # a peer that heartbeated once and went silent
+        host, port = kv._server.addr
+        ghost = kvs.ServerClient(host, port)
+        ghost.heartbeat(7)
+        ghost.close()
+        time.sleep(0.4)
+        assert kv.get_num_dead_node(timeout=0.2) == 1
+    finally:
+        kv.close()
+
+
+def test_dist_sync_single_process_dead_nodes():
+    kv = mx.kvstore.create("dist_sync")
+    assert kv.get_num_dead_node() == 0
