@@ -1,0 +1,86 @@
+/* Standalone C consumer of the prediction ABI — proves the embedding path
+ * (this process starts with NO Python interpreter; libmxtpu_capi.so brings
+ * one up).  Reference analogue: the image-classification predict example
+ * built on c_predict_api.h.
+ *
+ * Usage: demo <prefix> <epoch> <n_inputs> <input_dim>
+ * Reads <prefix>-symbol.json and <prefix>-<epoch 04d>.params, feeds a
+ * deterministic batch, prints the first output row as CSV.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu/c_predict_api.h"
+
+static char* read_file(const char* path, long* size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "cannot open %s\n", path); exit(2); }
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) { exit(2); }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s prefix epoch batch dim\n", argv[0]);
+    return 2;
+  }
+  const char* prefix = argv[1];
+  int epoch = atoi(argv[2]);
+  mx_uint batch = (mx_uint)atoi(argv[3]);
+  mx_uint dim = (mx_uint)atoi(argv[4]);
+
+  char path[512];
+  long sym_size, param_size;
+  snprintf(path, sizeof path, "%s-symbol.json", prefix);
+  char* sym_json = read_file(path, &sym_size);
+  snprintf(path, sizeof path, "%s-%04d.params", prefix, epoch);
+  char* params = read_file(path, &param_size);
+
+  const char* keys[2] = {"data", "softmax_label"};
+  mx_uint indptr[3] = {0, 2, 3};
+  mx_uint shapes[3] = {batch, dim, batch};
+  PredictorHandle h = NULL;
+  if (MXTPUPredCreate(sym_json, params, (int)param_size, 1, 0, 2, keys,
+                      indptr, shapes, &h) != 0) {
+    fprintf(stderr, "create failed: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+
+  float* data = (float*)malloc(sizeof(float) * batch * dim);
+  for (mx_uint i = 0; i < batch * dim; ++i) {
+    data[i] = (float)((i % 7) - 3) / 3.0f;  /* deterministic pattern */
+  }
+  if (MXTPUPredSetInput(h, "data", data, batch * dim) != 0 ||
+      MXTPUPredForward(h) != 0) {
+    fprintf(stderr, "forward failed: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+  mx_uint* oshape;
+  mx_uint ondim;
+  if (MXTPUPredGetOutputShape(h, 0, &oshape, &ondim) != 0) return 1;
+  mx_uint total = 1;
+  for (mx_uint i = 0; i < ondim; ++i) total *= oshape[i];
+  float* out = (float*)malloc(sizeof(float) * total);
+  if (MXTPUPredGetOutput(h, 0, out, total) != 0) {
+    fprintf(stderr, "get output failed: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+  mx_uint row = ondim > 1 ? oshape[ondim - 1] : total;
+  for (mx_uint i = 0; i < row; ++i) {
+    printf(i ? ",%g" : "%g", out[i]);
+  }
+  printf("\n");
+  MXTPUPredFree(h);
+  free(out);
+  free(data);
+  free(sym_json);
+  free(params);
+  return 0;
+}

@@ -1,0 +1,77 @@
+/*
+ * C prediction ABI for mxnet_tpu — the language-binding boundary.
+ *
+ * Signature-compatible with the reference predict API
+ * (/root/reference/include/mxnet/c_predict_api.h, implemented at
+ * src/c_api/c_predict_api.cc:41-280): load symbol JSON + a .params blob,
+ * bind static shapes, then SetInput / Forward / GetOutput.  Backed by the
+ * embedded Python runtime (mxnet_tpu.capi_shim) — the C layer is pure
+ * marshalling, the compute path is the same jitted executor every other
+ * frontend uses.
+ *
+ * All functions return 0 on success, -1 on failure (message via
+ * MXTPUGetLastError).
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* PredictorHandle;
+typedef uint32_t mx_uint;
+
+/* Last error message for this thread (empty string if none). */
+const char* MXTPUGetLastError(void);
+
+/*
+ * Create a predictor.
+ *  symbol_json        : symbol graph JSON (contents of *-symbol.json)
+ *  param_bytes/size   : image of a .params file (may be NULL/0 if the
+ *                       graph has no parameters)
+ *  dev_type           : 1 = cpu, 2 = gpu/accelerator (maps to context)
+ *  num_input_nodes    : number of input names
+ *  input_keys         : input names
+ *  input_shape_indptr : CSR-style offsets into input_shape_data,
+ *                       length num_input_nodes + 1
+ *  input_shape_data   : concatenated input shapes
+ */
+int MXTPUPredCreate(const char* symbol_json, const void* param_bytes,
+                    int param_size, int dev_type, int dev_id,
+                    mx_uint num_input_nodes, const char** input_keys,
+                    const mx_uint* input_shape_indptr,
+                    const mx_uint* input_shape_data, PredictorHandle* out);
+
+/* Copy float32 data into the named input. size = number of floats. */
+int MXTPUPredSetInput(PredictorHandle handle, const char* key,
+                      const float* data, mx_uint size);
+
+/* Run the bound forward graph. */
+int MXTPUPredForward(PredictorHandle handle);
+
+/* Shape of output `index`; *shape_data stays owned by the library and is
+ * valid until the next call on this handle. */
+int MXTPUPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                            mx_uint** shape_data, mx_uint* shape_ndim);
+
+/* Copy output `index` into data (float32). size = number of floats. */
+int MXTPUPredGetOutput(PredictorHandle handle, mx_uint index, float* data,
+                       mx_uint size);
+
+/* Re-bind to new input shapes sharing weights (MXPredReshape). */
+int MXTPUPredReshape(mx_uint num_input_nodes, const char** input_keys,
+                     const mx_uint* input_shape_indptr,
+                     const mx_uint* input_shape_data, PredictorHandle handle,
+                     PredictorHandle* out);
+
+/* Release the predictor. */
+int MXTPUPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_PREDICT_API_H_ */

@@ -1,0 +1,97 @@
+"""Python-side backing for the C prediction ABI (src/c_predict_api.cc).
+
+The C library embeds (or joins) a CPython interpreter and drives this shim
+with primitive types only — strings, bytes, ints — so the C side stays a
+thin marshalling layer.  Handles are integers into a registry, mirroring
+the reference's opaque ``PredictorHandle`` over C++ objects
+(/root/reference/src/c_predict_api.cc:41-280).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+_registry = {}
+_next_id = [1]
+_lock = threading.Lock()
+
+
+def create(symbol_json, params_bytes, input_keys, input_shapes, dev_type):
+    """-> integer handle.  ``params_bytes``: a .params file image;
+    ``input_shapes``: list of tuples aligned with ``input_keys``."""
+    import io as _io
+
+    from . import Predictor
+    from . import context as ctx_mod
+    from . import ndarray as nd
+    from .ndarray import _load_stream
+
+    params = _load_stream(_io.BytesIO(params_bytes)) if params_bytes else {}
+    if not isinstance(params, dict):
+        from .base import MXNetError
+
+        raise MXNetError(
+            "params blob has no names (list container); save checkpoints "
+            "as a name->array dict")
+    # reference dev_type codes (include/mxnet/base.h): 1=cpu, 2=gpu
+    ctx = ctx_mod.Context("gpu" if dev_type == 2 else "cpu")
+    shapes = {k: tuple(int(d) for d in s)
+              for k, s in zip(input_keys, input_shapes)}
+    pred = Predictor(symbol_json, params, shapes, ctx=ctx)
+    with _lock:
+        hid = _next_id[0]
+        _next_id[0] += 1
+        _registry[hid] = pred
+    return hid
+
+
+def _get(hid):
+    pred = _registry.get(hid)
+    if pred is None:
+        raise KeyError("invalid predictor handle %d" % hid)
+    return pred
+
+
+def set_input(hid, key, data_bytes, shape):
+    """``shape`` is the flat element count from the C caller (MXPredSetInput
+    passes data as a flat float buffer); reshape to the bound input."""
+    pred = _get(hid)
+    want = pred._input_shapes[key]
+    arr = np.frombuffer(data_bytes, np.float32).reshape(want)
+    pred.set_input(key, arr)
+
+
+def forward(hid):
+    pred = _get(hid)
+    pred._exec.forward(is_train=False)
+
+
+def num_outputs(hid):
+    return len(_get(hid).get_outputs())
+
+
+def get_output_shape(hid, index):
+    return tuple(int(d) for d in _get(hid).get_output(index).shape)
+
+
+def get_output(hid, index):
+    out = _get(hid).get_output(index).asnumpy().astype(np.float32)
+    return out.tobytes()
+
+
+def reshape(hid, input_keys, input_shapes):
+    """New handle bound to new shapes, sharing weights (MXPredReshape)."""
+    pred = _get(hid)
+    new = pred.reshape({k: tuple(int(d) for d in s)
+                        for k, s in zip(input_keys, input_shapes)})
+    with _lock:
+        hid2 = _next_id[0]
+        _next_id[0] += 1
+        _registry[hid2] = new
+    return hid2
+
+
+def free(hid):
+    with _lock:
+        _registry.pop(hid, None)

@@ -670,22 +670,27 @@ def save(fname: str, data) -> None:
             f.write(nb)
 
 
-def load(fname: str):
-    """Load a .params container; returns dict if names present, else list."""
-    with open(fname, "rb") as f:
-        magic, _res = struct.unpack("<QQ", f.read(16))
-        if magic != _MAGIC:
-            raise MXNetError("Invalid NDArray file format (magic %#x)" % magic)
-        (n,) = struct.unpack("<Q", f.read(8))
-        arrays = [_load_one(f) for _ in range(n)]
-        (nk,) = struct.unpack("<Q", f.read(8))
-        names = []
-        for _ in range(nk):
-            (ln,) = struct.unpack("<Q", f.read(8))
-            names.append(f.read(ln).decode("utf-8"))
+def _load_stream(f):
+    """Read a .params container from any binary file object."""
+    magic, _res = struct.unpack("<QQ", f.read(16))
+    if magic != _MAGIC:
+        raise MXNetError("Invalid NDArray file format (magic %#x)" % magic)
+    (n,) = struct.unpack("<Q", f.read(8))
+    arrays = [_load_one(f) for _ in range(n)]
+    (nk,) = struct.unpack("<Q", f.read(8))
+    names = []
+    for _ in range(nk):
+        (ln,) = struct.unpack("<Q", f.read(8))
+        names.append(f.read(ln).decode("utf-8"))
     if names:
         return dict(zip(names, arrays))
     return arrays
+
+
+def load(fname: str):
+    """Load a .params container; returns dict if names present, else list."""
+    with open(fname, "rb") as f:
+        return _load_stream(f)
 
 
 _init_ops()

@@ -1,0 +1,261 @@
+// C prediction ABI — implementation.
+//
+// Reference parity: /root/reference/src/c_api/c_predict_api.cc:41-280 and
+// c_api_error.cc (thread-local error string).  Design deviation, on
+// purpose: the reference's C layer sits ABOVE its C++ executor; here the
+// executor/compiler stack IS the Python/JAX runtime, so this layer embeds
+// (or joins) a CPython interpreter and marshals primitives into
+// mxnet_tpu.capi_shim.  The C surface stays flat and binding-friendly —
+// what made the reference's R/Scala/JS frontends possible.
+//
+// Works both as a standalone embedder (C program links libmxtpu_capi.so,
+// we Py_Initialize) and inside an existing Python process (ctypes dlopen,
+// we just take the GIL).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+using mx_uint = uint32_t;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+// Fetch the current Python exception into the error string.
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    if (PyObject* s = PyObject_Str(value)) {
+      if (const char* c = PyUnicode_AsUTF8(s)) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+std::once_flag g_init_once;
+bool g_we_initialized = false;
+
+void ensure_python() {
+  std::call_once(g_init_once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      g_we_initialized = true;
+      // release the GIL acquired by Py_Initialize so PyGILState_Ensure
+      // below works uniformly from any thread
+      PyEval_SaveThread();
+    }
+  });
+}
+
+struct GIL {
+  PyGILState_STATE state;
+  GIL() { state = PyGILState_Ensure(); }
+  ~GIL() { PyGILState_Release(state); }
+};
+
+PyObject* shim() {
+  static PyObject* mod = nullptr;  // accessed under the GIL only
+  if (!mod) {
+    mod = PyImport_ImportModule("mxnet_tpu.capi_shim");
+  }
+  return mod;
+}
+
+struct Predictor {
+  long long hid = 0;
+  std::vector<mx_uint> last_shape;  // backing for GetOutputShape
+};
+
+// shapes from the CSR arrays -> python list of tuples
+PyObject* shapes_to_py(mx_uint n, const mx_uint* indptr, const mx_uint* data) {
+  PyObject* list = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    mx_uint lo = indptr[i], hi = indptr[i + 1];
+    PyObject* tup = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SET_ITEM(tup, j - lo, PyLong_FromUnsignedLong(data[j]));
+    }
+    PyList_SET_ITEM(list, i, tup);
+  }
+  return list;
+}
+
+PyObject* keys_to_py(mx_uint n, const char** keys) {
+  PyObject* list = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyList_SET_ITEM(list, i, PyUnicode_FromString(keys[i]));
+  }
+  return list;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTPUGetLastError(void) { return g_last_error.c_str(); }
+
+int MXTPUPredCreate(const char* symbol_json, const void* param_bytes,
+                    int param_size, int dev_type, int dev_id,
+                    mx_uint num_input_nodes, const char** input_keys,
+                    const mx_uint* input_shape_indptr,
+                    const mx_uint* input_shape_data, void** out) {
+  (void)dev_id;
+  ensure_python();
+  GIL gil;
+  PyObject* mod = shim();
+  if (!mod) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* keys = keys_to_py(num_input_nodes, input_keys);
+  PyObject* shapes =
+      shapes_to_py(num_input_nodes, input_shape_indptr, input_shape_data);
+  PyObject* res = PyObject_CallMethod(
+      mod, "create", "sy#OOi", symbol_json,
+      static_cast<const char*>(param_bytes),
+      static_cast<Py_ssize_t>(param_size), keys, shapes, dev_type);
+  Py_DECREF(keys);
+  Py_DECREF(shapes);
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  auto* p = new Predictor();
+  p->hid = PyLong_AsLongLong(res);
+  Py_DECREF(res);
+  *out = p;
+  return 0;
+}
+
+int MXTPUPredSetInput(void* handle, const char* key, const float* data,
+                      mx_uint size) {
+  auto* p = static_cast<Predictor*>(handle);
+  GIL gil;
+  PyObject* res = PyObject_CallMethod(
+      shim(), "set_input", "Lsy#(k)", p->hid, key,
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(size * sizeof(float)),
+      static_cast<unsigned long>(size));
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUPredForward(void* handle) {
+  auto* p = static_cast<Predictor*>(handle);
+  GIL gil;
+  PyObject* res = PyObject_CallMethod(shim(), "forward", "L", p->hid);
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUPredGetOutputShape(void* handle, mx_uint index, mx_uint** shape_data,
+                            mx_uint* shape_ndim) {
+  auto* p = static_cast<Predictor*>(handle);
+  GIL gil;
+  PyObject* res =
+      PyObject_CallMethod(shim(), "get_output_shape", "Lk", p->hid,
+                          static_cast<unsigned long>(index));
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(res);
+  p->last_shape.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    p->last_shape[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(res, i)));
+  }
+  Py_DECREF(res);
+  *shape_data = p->last_shape.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+int MXTPUPredGetOutput(void* handle, mx_uint index, float* data,
+                       mx_uint size) {
+  auto* p = static_cast<Predictor*>(handle);
+  GIL gil;
+  PyObject* res = PyObject_CallMethod(shim(), "get_output", "Lk", p->hid,
+                                      static_cast<unsigned long>(index));
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    Py_DECREF(res);
+    set_error_from_python();
+    return -1;
+  }
+  if (static_cast<size_t>(len) != size * sizeof(float)) {
+    Py_DECREF(res);
+    set_error("output size mismatch: have " + std::to_string(len / 4) +
+              " floats, caller asked for " + std::to_string(size));
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUPredReshape(mx_uint num_input_nodes, const char** input_keys,
+                     const mx_uint* input_shape_indptr,
+                     const mx_uint* input_shape_data, void* handle,
+                     void** out) {
+  auto* p = static_cast<Predictor*>(handle);
+  GIL gil;
+  PyObject* keys = keys_to_py(num_input_nodes, input_keys);
+  PyObject* shapes =
+      shapes_to_py(num_input_nodes, input_shape_indptr, input_shape_data);
+  PyObject* res =
+      PyObject_CallMethod(shim(), "reshape", "LOO", p->hid, keys, shapes);
+  Py_DECREF(keys);
+  Py_DECREF(shapes);
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  auto* p2 = new Predictor();
+  p2->hid = PyLong_AsLongLong(res);
+  Py_DECREF(res);
+  *out = p2;
+  return 0;
+}
+
+int MXTPUPredFree(void* handle) {
+  auto* p = static_cast<Predictor*>(handle);
+  if (!p) return 0;
+  {
+    GIL gil;
+    PyObject* res = PyObject_CallMethod(shim(), "free", "L", p->hid);
+    if (res) Py_DECREF(res);
+    else PyErr_Clear();
+  }
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,149 @@
+"""C prediction ABI round trip: train -> checkpoint -> drive the graph
+through libmxtpu_capi.so via ctypes, exactly as a C program (or another
+language binding) would (reference: include/mxnet/c_predict_api.h and
+src/c_api/c_predict_api.cc:41-280)."""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+SO = os.path.join(ROOT, "mxnet_tpu", "libmxtpu_capi.so")
+
+
+def _build_lib():
+    if not os.path.exists(SO):
+        subprocess.run(["make", "capi"], cwd=os.path.join(ROOT, "src"),
+                       check=True, capture_output=True)
+    lib = ctypes.CDLL(SO)
+    lib.MXTPUGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _train_checkpoint(tmp_path):
+    np.random.seed(3)
+    X = np.random.randn(60, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=10)
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2})
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 3)
+    return prefix, X
+
+
+def test_c_predict_roundtrip(tmp_path):
+    lib = _build_lib()
+    prefix, X = _train_checkpoint(tmp_path)
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read().encode()
+    with open(prefix + "-0003.params", "rb") as f:
+        params = f.read()
+
+    batch = X[:10]
+    keys = (ctypes.c_char_p * 2)(b"data", b"softmax_label")
+    indptr = (ctypes.c_uint32 * 3)(0, 2, 3)
+    shapes = (ctypes.c_uint32 * 3)(10, 6, 10)
+    handle = ctypes.c_void_p()
+    rc = lib.MXTPUPredCreate(sym_json, params, len(params), 1, 0,
+                             2, keys, indptr, shapes, ctypes.byref(handle))
+    assert rc == 0, lib.MXTPUGetLastError().decode()
+
+    data = np.ascontiguousarray(batch, np.float32)
+    rc = lib.MXTPUPredSetInput(
+        handle, b"data", data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        data.size)
+    assert rc == 0, lib.MXTPUGetLastError().decode()
+    assert lib.MXTPUPredForward(handle) == 0
+
+    sdata = ctypes.POINTER(ctypes.c_uint32)()
+    ndim = ctypes.c_uint32()
+    rc = lib.MXTPUPredGetOutputShape(handle, 0, ctypes.byref(sdata),
+                                     ctypes.byref(ndim))
+    assert rc == 0
+    shape = tuple(sdata[i] for i in range(ndim.value))
+    assert shape == (10, 2)
+
+    out = np.zeros(shape, np.float32)
+    rc = lib.MXTPUPredGetOutput(
+        handle, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size)
+    assert rc == 0, lib.MXTPUGetLastError().decode()
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(10), rtol=1e-5)
+
+    # must equal the Python Predictor on the same checkpoint
+    pred = mx.Predictor(prefix + "-symbol.json", prefix + "-0003.params",
+                        {"data": (10, 6), "softmax_label": (10,)})
+    want = pred.forward(data=batch)[0].asnumpy()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    # reshape shares weights and serves a different batch size
+    indptr4 = (ctypes.c_uint32 * 3)(0, 2, 3)
+    shapes4 = (ctypes.c_uint32 * 3)(4, 6, 4)
+    h4 = ctypes.c_void_p()
+    rc = lib.MXTPUPredReshape(2, keys, indptr4, shapes4, handle,
+                              ctypes.byref(h4))
+    assert rc == 0, lib.MXTPUGetLastError().decode()
+    d4 = np.ascontiguousarray(batch[:4], np.float32)
+    assert lib.MXTPUPredSetInput(
+        h4, b"data", d4.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        d4.size) == 0
+    assert lib.MXTPUPredForward(h4) == 0
+    out4 = np.zeros((4, 2), np.float32)
+    assert lib.MXTPUPredGetOutput(
+        h4, 0, out4.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out4.size) == 0
+    np.testing.assert_allclose(out4, want[:4], rtol=1e-4, atol=1e-6)
+
+    assert lib.MXTPUPredFree(h4) == 0
+    assert lib.MXTPUPredFree(handle) == 0
+
+
+def test_c_predict_error_reporting(tmp_path):
+    lib = _build_lib()
+    keys = (ctypes.c_char_p * 1)(b"data",)
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    shapes = (ctypes.c_uint32 * 2)(4, 4)
+    handle = ctypes.c_void_p()
+    rc = lib.MXTPUPredCreate(b"{not json", None, 0, 1, 0, 1, keys, indptr,
+                             shapes, ctypes.byref(handle))
+    assert rc == -1
+    assert len(lib.MXTPUGetLastError()) > 0
+
+
+def test_standalone_c_embedder(tmp_path):
+    """Compile and run a real C program against the ABI: the process starts
+    with no Python; the library embeds the interpreter itself."""
+    lib = _build_lib()  # ensure the .so exists
+    del lib
+    prefix, X = _train_checkpoint(tmp_path)
+    exe = str(tmp_path / "demo")
+    import sysconfig
+
+    libdir = sysconfig.get_config_var("LIBDIR")
+    res = subprocess.run(
+        ["gcc", "-O2", os.path.join(ROOT, "examples", "c_predict", "demo.c"),
+         "-I", os.path.join(ROOT, "include"),
+         "-L", os.path.join(ROOT, "mxnet_tpu"), "-lmxtpu_capi",
+         "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu"),
+         "-Wl,-rpath," + libdir, "-o", exe],
+        capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": ROOT}
+    run = subprocess.run([exe, str(tmp_path / "m"), "3", "10", "6"],
+                         capture_output=True, text=True, timeout=240,
+                         env=env)
+    assert run.returncode == 0, (run.stdout, run.stderr)
+    row = [float(v) for v in run.stdout.strip().split(",")]
+    assert len(row) == 2 and abs(sum(row) - 1.0) < 1e-4  # softmax row

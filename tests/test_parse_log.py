@@ -1,0 +1,74 @@
+"""tools/parse_log.py: parse a REAL training log produced by Module.fit +
+Speedometer and gate on accuracy (reference CI pattern,
+tests/nightly/test_all.sh:43-60)."""
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def _train_with_log(tmp_path):
+    logfile = str(tmp_path / "train.log")
+    logger = logging.getLogger("parse_log_test")
+    logger.setLevel(logging.INFO)
+    handler = logging.FileHandler(logfile)
+    handler.setFormatter(logging.Formatter("%(asctime)-15s %(message)s"))
+    logger.addHandler(handler)
+    # Speedometer logs through the root logger
+    root_handler = logging.FileHandler(logfile)
+    logging.getLogger().addHandler(root_handler)
+    try:
+        np.random.seed(0)
+        X = np.random.randn(120, 10).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        it = mx.io.NDArrayIter(X, y, batch_size=12)
+        val = mx.io.NDArrayIter(X, y, batch_size=12)
+        net = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+            name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu(), logger=logger)
+        mod.fit(it, eval_data=val, num_epoch=3, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.3},
+                batch_end_callback=mx.callback.Speedometer(12, 5))
+    finally:
+        logger.removeHandler(handler)
+        logging.getLogger().removeHandler(root_handler)
+        handler.close()
+        root_handler.close()
+    return logfile
+
+
+def test_parse_log_end_to_end(tmp_path):
+    logfile = _train_with_log(tmp_path)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "parse_log.py"),
+         logfile, "--format", "json"],
+        capture_output=True, text=True, check=True)
+    epochs = json.loads(out.stdout)
+    assert set(epochs) == {"0", "1", "2"}
+    for rec in epochs.values():
+        assert "train-accuracy" in rec and "time_cost" in rec
+        assert "validation-accuracy" in rec
+        assert rec.get("speed", 1.0) > 0
+    # accuracy improves and the CI gate passes
+    gate = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "parse_log.py"),
+         logfile, "--metric", "validation-accuracy", "--last",
+         "--assert-min", "0.9"],
+        capture_output=True, text=True)
+    assert gate.returncode == 0, (gate.stdout, gate.stderr)
+    assert float(gate.stdout.strip()) > 0.9
+    # and fails when the bar is unreachable
+    gate2 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "parse_log.py"),
+         logfile, "--metric", "validation-accuracy", "--last",
+         "--assert-min", "1.01"],
+        capture_output=True, text=True)
+    assert gate2.returncode == 1

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""parse_log — extract per-epoch metrics/throughput from training logs.
+
+Capability parity with the reference's log parser (used by its CI accuracy
+gates, /root/reference/tools/parse_log.py and tests/nightly/test_all.sh:
+43-60 which grep final validation accuracy); written for this framework's
+log format (base_module.fit epoch lines + callback.Speedometer batch
+lines).
+
+Usage:
+  python tools/parse_log.py train.log                  # markdown table
+  python tools/parse_log.py train.log --format json    # one JSON object
+  python tools/parse_log.py train.log --metric accuracy --last
+      # print just the final value of one metric (CI gate helper):
+      #   python tools/parse_log.py log --metric validation-accuracy \
+      #       --last --assert-min 0.99
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from collections import defaultdict
+
+# Epoch[3] Train-accuracy=0.981200  /  Epoch[3] Validation-accuracy=0.97
+_EPOCH_METRIC = re.compile(
+    r"Epoch\[(\d+)\][^\n]*?\b(Train|Validation)-([\w-]+)=([0-9.eE+-]+)")
+# Epoch[3] Time cost=12.345
+_EPOCH_TIME = re.compile(r"Epoch\[(\d+)\][^\n]*?Time cost=([0-9.eE+-]+)")
+# Epoch[3] Batch [40]  Speed: 1234.56 samples/sec
+_SPEED = re.compile(
+    r"Epoch\[(\d+)\][^\n]*?Speed: ([0-9.eE+-]+) samples/sec")
+
+
+def parse(text):
+    """-> {epoch: {metric_name: value, ..., "time_cost": s, "speed": avg}}"""
+    epochs = defaultdict(dict)
+    speeds = defaultdict(list)
+    for m in _EPOCH_METRIC.finditer(text):
+        epoch, phase, name, value = m.groups()
+        key = "%s-%s" % (phase.lower(), name)
+        try:
+            epochs[int(epoch)][key] = float(value)
+        except ValueError:
+            continue
+    for m in _EPOCH_TIME.finditer(text):
+        epochs[int(m.group(1))]["time_cost"] = float(m.group(2))
+    for m in _SPEED.finditer(text):
+        speeds[int(m.group(1))].append(float(m.group(2)))
+    for epoch, vals in speeds.items():
+        epochs[epoch]["speed"] = sum(vals) / len(vals)
+    return dict(sorted(epochs.items()))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="parse fit/Speedometer training logs")
+    ap.add_argument("logfile", help="path, or - for stdin")
+    ap.add_argument("--format", choices=("table", "json"), default="table")
+    ap.add_argument("--metric", default=None,
+                    help="print one metric's series (e.g. train-accuracy)")
+    ap.add_argument("--last", action="store_true",
+                    help="with --metric: print only the final value")
+    ap.add_argument("--assert-min", type=float, default=None,
+                    help="exit 1 unless the (final) metric value >= this "
+                         "(the CI accuracy gate)")
+    args = ap.parse_args(argv)
+
+    if args.metric is None and (args.assert_min is not None or args.last):
+        ap.error("--assert-min/--last require --metric")
+    text = sys.stdin.read() if args.logfile == "-" else \
+        open(args.logfile).read()
+    epochs = parse(text)
+    if not epochs:
+        print("no epoch records found", file=sys.stderr)
+        return 1
+
+    if args.metric:
+        series = [(e, v[args.metric]) for e, v in epochs.items()
+                  if args.metric in v]
+        if not series:
+            print("metric %r not found; available: %s"
+                  % (args.metric,
+                     sorted({k for v in epochs.values() for k in v})),
+                  file=sys.stderr)
+            return 1
+        if args.last:
+            print(series[-1][1])
+        else:
+            for e, v in series:
+                print(e, v)
+        if args.assert_min is not None and series[-1][1] < args.assert_min:
+            print("FAIL: %s=%.6f < %.6f" % (args.metric, series[-1][1],
+                                            args.assert_min),
+                  file=sys.stderr)
+            return 1
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(epochs))
+        return 0
+    cols = sorted({k for v in epochs.values() for k in v})
+    print("| epoch | " + " | ".join(cols) + " |")
+    print("|" + "---|" * (len(cols) + 1))
+    for e, v in epochs.items():
+        print("| %d | " % e +
+              " | ".join("%.6g" % v[c] if c in v else "" for c in cols) +
+              " |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
