@@ -47,6 +47,17 @@ def get_network(args):
         return mx.models.get_alexnet(num_classes=args.num_classes)
     if name.startswith("inception"):
         return mx.models.get_inception_bn(num_classes=args.num_classes)
+    if name.startswith("vgg"):
+        import re as _re
+
+        m = _re.fullmatch(r"vgg-?(\d+)?", name)
+        if m is None:
+            raise ValueError("cannot parse vgg depth from %r" % name)
+        num_layers = int(m.group(1)) if m.group(1) else 16
+        return mx.models.get_vgg(num_classes=args.num_classes,
+                                 num_layers=num_layers)
+    if name == "googlenet":
+        return mx.models.get_googlenet(num_classes=args.num_classes)
     raise ValueError("unknown network %s" % name)
 
 

@@ -61,3 +61,5 @@ from . import models
 from . import contrib
 from .predictor import Predictor, load_exported
 from .ops import register_pallas_op, Param
+from . import rtc
+from . import torch as th

@@ -1,0 +1,40 @@
+"""VGG symbol builder (Simonyan & Zisserman 2014).
+
+Capability parity with reference example/image-classification/symbols/vgg.py
+(one of the benchmark model families) — written fresh: conv widths are
+powers of two so bf16 MXU tiles stay full; the classifier keeps the two
+4096-wide FC layers of the paper.
+"""
+from .. import symbol as sym
+
+_CONFIGS = {
+    11: ((64,), (128,), (256, 256), (512, 512), (512, 512)),
+    13: ((64, 64), (128, 128), (256, 256), (512, 512), (512, 512)),
+    16: ((64, 64), (128, 128), (256, 256, 256), (512, 512, 512),
+         (512, 512, 512)),
+    19: ((64, 64), (128, 128), (256, 256, 256, 256), (512, 512, 512, 512),
+         (512, 512, 512, 512)),
+}
+
+
+def get_vgg(num_classes=1000, num_layers=16, batch_norm=False):
+    if num_layers not in _CONFIGS:
+        raise ValueError("vgg depth must be one of %s" % sorted(_CONFIGS))
+    net = sym.Variable("data")
+    for si, widths in enumerate(_CONFIGS[num_layers]):
+        for ci, width in enumerate(widths):
+            name = "conv%d_%d" % (si + 1, ci + 1)
+            net = sym.Convolution(net, num_filter=width, kernel=(3, 3),
+                                  pad=(1, 1), name=name)
+            if batch_norm:
+                net = sym.BatchNorm(net, fix_gamma=False, name=name + "_bn")
+            net = sym.Activation(net, act_type="relu", name=name + "_relu")
+        net = sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                          name="pool%d" % (si + 1))
+    net = sym.Flatten(net)
+    for i, width in enumerate((4096, 4096)):
+        net = sym.FullyConnected(net, num_hidden=width, name="fc%d" % (i + 6))
+        net = sym.Activation(net, act_type="relu", name="relu%d" % (i + 6))
+        net = sym.Dropout(net, p=0.5, name="drop%d" % (i + 6))
+    net = sym.FullyConnected(net, num_hidden=num_classes, name="fc8")
+    return sym.SoftmaxOutput(net, name="softmax")

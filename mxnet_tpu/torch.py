@@ -1,0 +1,114 @@
+"""``mx.th`` — call (Py)Torch functions on NDArrays.
+
+Parity: the reference bridges Torch7 tensor math into MXNet
+(/root/reference/python/mxnet/torch.py over plugin/torch/): ``mx.th.foo``
+runs a torch function on MXNet arrays.  Here the bridge targets PyTorch
+(CPU build, baked into this image): NDArrays convert to torch tensors
+(zero-copy through numpy where dtypes allow), the torch callable runs
+eagerly on host, and results wrap back as NDArrays.  This is an escape
+hatch for host-side math — it does not trace into jitted graphs (use the
+op registry / ``register_pallas_op`` for that), matching the reference's
+"runs outside the engine's typed path" caveat for its torch bridge.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["apply", "is_available"]
+
+
+def is_available() -> bool:
+    try:
+        import torch  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def _to_torch(v):
+    import torch
+
+    from . import ndarray as nd
+
+    if isinstance(v, nd.NDArray):
+        # asnumpy() can be a zero-copy view of the immutable JAX buffer:
+        # torch in-place ops on it would corrupt the array behind JAX's
+        # back, so hand torch its own writable copy
+        return torch.from_numpy(np.array(v.asnumpy()))
+    if isinstance(v, np.ndarray):
+        arr = np.ascontiguousarray(v)
+        if not arr.flags.writeable:
+            arr = arr.copy()
+        return torch.from_numpy(arr)
+    return v
+
+
+def _from_torch(v):
+    import torch
+
+    from . import ndarray as nd
+
+    if isinstance(v, torch.Tensor):
+        return nd.array(v.detach().cpu().numpy())
+    if isinstance(v, (list, tuple)):
+        return type(v)(_from_torch(x) for x in v)
+    return v
+
+
+def apply(fn, *args, **kwargs):
+    """Run ``fn`` (a torch callable or dotted name like ``"fft.rfft"``)
+    on NDArray/numpy arguments; NDArrays come back out."""
+    import torch
+
+    if isinstance(fn, str):
+        obj = torch
+        for part in fn.split("."):
+            obj = getattr(obj, part)
+        fn = obj
+    out = fn(*[_to_torch(a) for a in args],
+             **{k: _to_torch(v) for k, v in kwargs.items()})
+    return _from_torch(out)
+
+
+class _TorchModule(types.ModuleType):
+    """Attribute access forwards to torch: ``mx.th.exp(x)``,
+    ``mx.th.linalg.svd(m)`` — the reference's generated mx.th surface."""
+
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        try:
+            import torch
+        except Exception:
+            raise MXNetError(
+                "mx.th requires torch, which is unavailable in this "
+                "environment")
+        target = getattr(torch, name)
+        if isinstance(target, types.ModuleType):
+            sub = _TorchNamespace(target)
+            return sub
+        if callable(target):
+            return lambda *a, **kw: apply(target, *a, **kw)
+        return target
+
+
+class _TorchNamespace:
+    def __init__(self, mod):
+        self._mod = mod
+
+    def __getattr__(self, name):
+        target = getattr(self._mod, name)
+        if isinstance(target, types.ModuleType):
+            return _TorchNamespace(target)
+        if callable(target):
+            return lambda *a, **kw: apply(target, *a, **kw)
+        return target
+
+
+sys.modules[__name__].__class__ = _TorchModule

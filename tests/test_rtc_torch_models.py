@@ -1,0 +1,62 @@
+"""mx.rtc (runtime kernel-string compilation), mx.th (torch bridge), and
+the VGG/GoogLeNet model builders.
+
+Reference parity: src/common/mxrtc.cc:117-135 + python/mxnet/rtc.py (NVRTC
+kernel strings), python/mxnet/torch.py (torch function bridge),
+example/image-classification/symbols/{vgg,googlenet}.py."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_rtc_kernel_string_compiles_and_runs():
+    x = mx.nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    y = mx.nd.ones((2, 4))
+    out = mx.nd.zeros((2, 4))
+    krnl = mx.rtc.MXRtc("axpy", [("x", x), ("y", y)], [("out", out)], """
+    def kernel(x_ref, y_ref, out_ref):
+        out_ref[...] = 2.0 * x_ref[...] + y_ref[...]
+    """)
+    krnl.push([x, y], [out])
+    np.testing.assert_allclose(out.asnumpy(), 2 * x.asnumpy() + 1)
+    # push twice: compiled object is cached, results stay right
+    krnl.push([y, y], [out])
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 4), 3.0))
+
+
+def test_rtc_rejects_bad_source():
+    x = mx.nd.ones((2,))
+    with pytest.raises(mx.base.MXNetError, match="compile"):
+        mx.rtc.MXRtc("bad", [("x", x)], [("o", x)], "def kernel(: syntax")
+
+
+def test_torch_bridge():
+    if not mx.th.is_available():
+        pytest.skip("torch not available")
+    x = mx.nd.array(np.array([[1.0, 4.0], [9.0, 16.0]], np.float32))
+    out = mx.th.sqrt(x)
+    assert isinstance(out, mx.nd.NDArray)
+    np.testing.assert_allclose(out.asnumpy(), [[1, 2], [3, 4]])
+    # nested namespace + multi-output
+    u, s, v = mx.th.linalg.svd(x)
+    assert isinstance(s, mx.nd.NDArray) and s.shape == (2,)
+    # apply() with dotted name
+    out2 = mx.th.apply("clamp", x, min=2.0, max=10.0)
+    np.testing.assert_allclose(out2.asnumpy(), [[2, 4], [9, 10]])
+
+
+@pytest.mark.parametrize("builder,kwargs,n_args", [
+    ("get_vgg", {"num_layers": 11, "num_classes": 10}, None),
+    ("get_googlenet", {"num_classes": 10}, None),
+])
+def test_new_model_builders_infer_and_run(builder, kwargs, n_args):
+    net = getattr(mx.models, builder)(**kwargs)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 3, 64, 64))
+    assert out_shapes[0] == (2, 10)
+    ex = net.simple_bind(mx.cpu(), data=(2, 3, 64, 64),
+                         softmax_label=(2,), grad_req="null")
+    ex.forward(is_train=False)
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-4)

@@ -1,0 +1,77 @@
+"""Flash-attention kernel benchmark — the framework's high-MFU path.
+
+Times a jitted causal-attention TRAIN step (fwd + the Pallas backward
+kernels) at transformer shapes, reporting achieved TFLOP/s and MFU against
+the chip's bf16 peak. Causal attention FLOPs are counted as
+0.5 * (4*b*h*s^2*d) forward + 2x that for backward (dQ + dK/dV each
+recompute P), i.e. 3x forward — the same accounting PERF.md uses.
+
+Usage: python tools/bench_attention.py [--seq 16384] [--steps 10]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--seq", type=int, default=16384)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--block", type=int, default=128)
+    cli = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.ops.attention import flash_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    b, h, d = cli.batch, cli.heads, cli.head_dim
+    s = cli.seq if on_tpu else min(cli.seq, 512)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (b, s, h, d), dt) * 0.1
+    k = jax.random.normal(key, (b, s, h, d), dt) * 0.1
+    v = jax.random.normal(key, (b, s, h, d), dt) * 0.1
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=cli.block,
+                            block_k=cli.block)
+        return jnp.mean(o.astype(jnp.float32) ** 2)
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    chain = jax.jit(lambda q, dq: q + 0 * dq)  # data-dependence between steps
+
+    g = step(q, k, v)
+    q = chain(q, g[0])
+    np.asarray(q[0, 0, 0, 0])
+    t0 = time.time()
+    for _ in range(cli.steps):
+        g = step(q, k, v)
+        q = chain(q, g[0])
+    np.asarray(q[0, 0, 0, 0])
+    dt_s = (time.time() - t0) / cli.steps
+
+    fwd_flops = 0.5 * 4.0 * b * h * s * s * d  # causal: half the s^2 grid
+    total = 3.0 * fwd_flops
+    peak = 197e12 if on_tpu else None
+    print(json.dumps({
+        "metric": "flash_attention_train_tflops",
+        "value": round(total / dt_s / 1e12, 2), "unit": "TFLOP/s",
+        "seq": s, "batch": b, "heads": h, "head_dim": d,
+        "step_ms": round(dt_s * 1e3, 2),
+        "mfu": round(total / dt_s / peak, 4) if peak else None,
+        "backend": jax.default_backend()}))
+
+
+if __name__ == "__main__":
+    main()
