@@ -63,3 +63,4 @@ from .predictor import Predictor, load_exported
 from .ops import register_pallas_op, Param
 from . import rtc
 from . import torch as th
+from . import checkpoint

@@ -1,0 +1,98 @@
+"""Sharded / async checkpointing — the TPU-native half of the checkpoint
+story (SURVEY §5.4).
+
+The reference persists ``prefix-symbol.json`` + a dmlc stream of named
+arrays (``.params``, /root/reference/src/ndarray/ndarray.cc:633-714); this
+framework keeps that format bit-compatible (``mx.nd.save/load``) for
+interchange.  This module adds the TPU-era equivalent on top: an
+orbax-backed checkpoint keyed by the SAME name->array dicts, which
+
+  * writes each device shard from the process that owns it (multi-host
+    global-mesh training checkpoints without gathering to one host),
+  * restores with the arrays' shardings preserved,
+  * round-trips the symbol JSON next to the weights.
+
+API mirrors ``mx.model.save_checkpoint``/``load_checkpoint``.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+from .base import MXNetError
+
+__all__ = ["save_sharded_checkpoint", "load_sharded_checkpoint"]
+
+
+def _to_tree(arg_params, aux_params):
+    from . import ndarray as nd
+
+    def unwrap(d):
+        return {k: (v._data if isinstance(v, nd.NDArray) else v)
+                for k, v in (d or {}).items()}
+
+    return {"arg": unwrap(arg_params), "aux": unwrap(aux_params)}
+
+
+def save_sharded_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Write ``prefix-symbol.json`` + ``prefix-<epoch>.orbax/`` (a sharded
+    orbax tree).  In multi-process jobs every process must call this
+    collectively; each writes only its addressable shards."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    if symbol is not None and jax.process_index() == 0:
+        # one writer: N processes saving collectively must not race on the
+        # shared symbol file
+        symbol.save("%s-symbol.json" % prefix)
+    path = os.path.abspath("%s-%04d.orbax" % (prefix, epoch))
+    tree = _to_tree(arg_params, aux_params)
+    ckpt = ocp.PyTreeCheckpointer()
+    ckpt.save(path, tree, force=True)
+    return path
+
+
+def load_sharded_checkpoint(prefix, epoch, shardings=None):
+    """-> (symbol_or_None, arg_params, aux_params) as NDArray dicts.
+
+    ``shardings``: optional ``{"arg"/"aux": {name: jax.sharding}}`` tree to
+    restore arrays directly onto a mesh (multi-host restore).
+    """
+    from . import ndarray as nd
+    from . import symbol as sym
+
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath("%s-%04d.orbax" % (prefix, epoch))
+    if not os.path.isdir(path):
+        raise MXNetError("no sharded checkpoint at %s" % path)
+    ckpt = ocp.PyTreeCheckpointer()
+    if shardings is not None:
+        # pass shardings INTO orbax so each process reads only the shards
+        # it owns (no full-tree materialization per host)
+        meta = ckpt.metadata(path)
+        tree_meta = getattr(meta, "item_metadata", meta)
+        restore_args = {
+            grp: {k: (ocp.ArrayRestoreArgs(
+                          sharding=shardings.get(grp, {}).get(k))
+                      if shardings.get(grp, {}).get(k) is not None
+                      else ocp.RestoreArgs())
+                  for k in sub}
+            for grp, sub in tree_meta.items()}
+        tree = ckpt.restore(path, restore_args=restore_args)
+    else:
+        tree = ckpt.restore(path)
+    symbol = None
+    sym_path = "%s-symbol.json" % prefix
+    if os.path.exists(sym_path):
+        symbol = sym.load(sym_path)
+    arg = {k: nd.NDArray(_as_jax(v)) for k, v in tree.get("arg", {}).items()}
+    aux = {k: nd.NDArray(_as_jax(v)) for k, v in tree.get("aux", {}).items()}
+    return symbol, arg, aux
+
+
+def _as_jax(v):
+    import jax.numpy as jnp
+
+    return v if hasattr(v, "devices") else jnp.asarray(v)

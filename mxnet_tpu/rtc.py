@@ -43,22 +43,27 @@ class MXRtc:
                  outputs: Sequence[Tuple[str, object]], kernel_src: str,
                  **pallas_kwargs):
         self.name = name
-        self._in_names = [n for n, _ in inputs]
+        self._in_protos = [(n, tuple(a.shape)) for n, a in inputs]
         self._out_protos = [(n, tuple(a.shape), a.dtype)
                             for n, a in outputs]
         self._pallas_kwargs = dict(pallas_kwargs)
         src = textwrap.dedent(kernel_src)
+        srcfile = "<mx.rtc:%s>" % name
         scope = {}
         try:
-            exec(compile(src, "<mx.rtc:%s>" % name, "exec"), scope)
-        except SyntaxError as e:
+            exec(compile(src, srcfile, "exec"), scope)
+        except Exception as e:
             raise MXNetError("rtc kernel %r failed to compile: %s"
                              % (name, e))
         fn = scope.get("kernel")
         if fn is None:
-            # accept a single function under any name (reference kernels
-            # are named by the user)
-            fns = [v for v in scope.values() if callable(v)]
+            # accept a single function DEFINED in the source under any name
+            # (imported callables don't count — reference kernels are named
+            # by the user)
+            fns = [v for v in scope.values()
+                   if callable(v) and
+                   getattr(getattr(v, "__code__", None), "co_filename",
+                           None) == srcfile]
             if len(fns) != 1:
                 raise MXNetError(
                     "rtc kernel source must define exactly one function "
@@ -88,6 +93,15 @@ class MXRtc:
 
         if self._compiled is None:
             self._build()
+        if len(ins) != len(self._in_protos):
+            raise MXNetError(
+                "rtc %r expects %d inputs, got %d"
+                % (self.name, len(self._in_protos), len(ins)))
+        for arr, (pname, shape) in zip(ins, self._in_protos):
+            if tuple(arr.shape) != shape:
+                raise MXNetError(
+                    "rtc %r input %s shape %s does not match prototype %s"
+                    % (self.name, pname, tuple(arr.shape), shape))
         if len(outs) != len(self._out_protos):
             raise MXNetError(
                 "rtc %r expects %d outputs, got %d"

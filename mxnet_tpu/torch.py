@@ -46,6 +46,8 @@ def _to_torch(v):
         if not arr.flags.writeable:
             arr = arr.copy()
         return torch.from_numpy(arr)
+    if isinstance(v, (list, tuple)):
+        return type(v)(_to_torch(x) for x in v)
     return v
 
 
