@@ -834,6 +834,36 @@ def _register_image_ops():
                      constant_values=val)
         return jnp.asarray(out)
 
+    @reg_op("_imdecode", inputs=("mean", "str_img"),
+            params={"index": Param(int, default=0),
+                    "x0": Param(int, default=0), "y0": Param(int, default=0),
+                    "x1": Param(int, default=0), "y1": Param(int, default=0),
+                    "c": Param(int, default=3), "size": Param(int, default=0)},
+            hint="imdecode_fun")
+    def _imdecode_fun(opctx, attrs, mean, str_img):
+        """Registered NDArray function ``_imdecode`` (reference
+        src/ndarray/ndarray.cc registered fun ``_imdecode``): decode image
+        ``index`` (of byte length ``size``) from a packed uint8 buffer,
+        optional crop box, CHW float32 output with an optional CHW mean
+        subtracted — the reference's layout contract."""
+        import jax.numpy as jnp
+
+        buf = np.asarray(str_img).tobytes()
+        size = attrs["size"]
+        if size > 0:
+            buf = buf[attrs["index"] * size:(attrs["index"] + 1) * size]
+        arr = image_backend.decode_image(buf, channels=attrs["c"])
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        x0, y0, x1, y1 = (attrs[k] for k in ("x0", "y0", "x1", "y1"))
+        if x1 > x0 and y1 > y0:
+            arr = arr[y0:y1, x0:x1]
+        out = np.transpose(arr.astype(np.float32), (2, 0, 1))  # CHW
+        m = np.asarray(mean, np.float32)
+        if m.ndim >= 2 or m.size > 1 or float(m.reshape(-1)[0]) != 0.0:
+            out = out - m  # CHW mean (ndarray.cc:876-879), broadcast rules
+        return jnp.asarray(out)
+
 
 _register_image_ops()
 

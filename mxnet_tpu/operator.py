@@ -397,6 +397,32 @@ def _custom_infer_shape(attrs, in_shapes):
     return _normalize_shapes(prop, in_shapes)
 
 
+def _register_legacy_callback_stubs():
+    """``_Native``/``_NDArray`` nodes carry serialized C function POINTERS
+    in the reference's JSON (python/mxnet/operator.py:19-226 pack ctypes
+    addresses into the ``info`` attr) — not portable to any other process,
+    in the reference either.  Register the names so such graphs LOAD and
+    introspect; executing one raises with the porting path."""
+    from .base import MXNetError
+    from .ops.registry import register as reg_op
+
+    def _make(name):
+        @reg_op(name, inputs=("data",), allow_extra_attrs=True,
+                hint=name.strip("_").lower())
+        def _stub(opctx, attrs, *arrays):
+            raise MXNetError(
+                "%s carries process-local callback pointers and cannot "
+                "execute from a serialized graph; re-create the op with "
+                "PythonOp/NDArrayOp.get_symbol or mx.operator.register "
+                "(Custom)" % name)
+
+    _make("_Native")
+    _make("_NDArray")
+
+
+_register_legacy_callback_stubs()
+
+
 def _register_custom_op():
     from .ops.param import Param
     from .ops.registry import register as reg_op

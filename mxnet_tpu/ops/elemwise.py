@@ -85,9 +85,42 @@ for _name, _jfn in _UNARY.items():
     _register_unary(_name, _jfn)
 
 
-@register("_copy", aliases=("identity",), hint="copy")
+@register("_copy", aliases=("identity", "_copyto"), hint="copy")
 def _copy(opctx, attrs, x):
     return x
+
+
+@register("_CrossDeviceCopy", hint="crossdevicecopy")
+def _cross_device_copy(opctx, attrs, x):
+    """Identity at the op level: the reference splices this node at ctx
+    boundaries (src/operator/cross_device_copy.cc) and its engine moves the
+    bytes; here the executor's placement map compiles the device transfer
+    (jax.device_put) into the step, so graphs loaded from reference JSON
+    that contain this node run unchanged."""
+    return x
+
+
+def _broadcast_fun_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    out = list(d)
+    out[attrs["axis"]] = attrs["size"]
+    return in_shapes, [tuple(out)], []
+
+
+@register("_broadcast", params={"axis": Param(int, required=True),
+                                "size": Param(int, required=True)},
+          infer_shape=_broadcast_fun_infer, hint="broadcastfun")
+def _broadcast_fun(opctx, attrs, x):
+    """Registered NDArray function ``_broadcast`` (reference
+    src/ndarray/ndarray.cc:898: "Broadcast array in the given axis to the
+    given size"; the size-1 axis expands).  Call with keyword params:
+    ``mx.nd._broadcast(x, axis=0, size=4)``."""
+    axis, size = attrs["axis"], attrs["size"]
+    shape = list(x.shape)
+    shape[axis] = size
+    return jnp.broadcast_to(x, tuple(shape))
 
 
 @register("BlockGrad", aliases=("stop_gradient",), hint="blockgrad")
