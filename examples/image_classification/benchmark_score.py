@@ -25,6 +25,8 @@ def score(network, batch_size, image_shape, num_classes, num_batches=20):
                                    image_shape=image_shape)
     elif network == "alexnet":
         sym = mx.models.get_alexnet(num_classes=num_classes)
+    elif network in ("inception-v3", "inception_v3"):
+        sym = mx.models.get_inception_v3(num_classes=num_classes)
     elif network.startswith("inception"):
         sym = mx.models.get_inception_bn(num_classes=num_classes)
     elif network == "lenet":

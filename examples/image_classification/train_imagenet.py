@@ -45,6 +45,8 @@ def get_network(args):
                                     num_layers=num_layers, image_shape=shape)
     if name == "alexnet":
         return mx.models.get_alexnet(num_classes=args.num_classes)
+    if name in ("inception-v3", "inception_v3"):
+        return mx.models.get_inception_v3(num_classes=args.num_classes)
     if name.startswith("inception"):
         return mx.models.get_inception_bn(num_classes=args.num_classes)
     if name.startswith("vgg"):

@@ -860,8 +860,10 @@ def _register_image_ops():
             arr = arr[y0:y1, x0:x1]
         out = np.transpose(arr.astype(np.float32), (2, 0, 1))  # CHW
         m = np.asarray(mean, np.float32)
-        if m.ndim >= 2 or m.size > 1 or float(m.reshape(-1)[0]) != 0.0:
-            out = out - m  # CHW mean (ndarray.cc:876-879), broadcast rules
+        # empty mean or scalar 0 means "no subtraction" (ndarray.cc:876-879)
+        if m.size and (m.ndim >= 2 or m.size > 1
+                       or float(m.reshape(-1)[0]) != 0.0):
+            out = out - m  # CHW mean, broadcast rules
         return jnp.asarray(out)
 
 

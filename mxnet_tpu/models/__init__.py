@@ -7,10 +7,12 @@ from .mlp import get_mlp
 from .resnet import get_resnet
 from .alexnet import get_alexnet
 from .inception_bn import get_inception_bn
+from .inception_v3 import get_inception_v3
 from .vgg import get_vgg
 from .googlenet import get_googlenet
 from .ssd import get_ssd_train, get_ssd_detect, get_ssd_symbols
 
 __all__ = ["get_ssd_train", "get_ssd_detect", "get_ssd_symbols",
            "get_lenet", "get_mlp", "get_resnet", "get_alexnet",
-           "get_inception_bn", "get_vgg", "get_googlenet"]
+           "get_inception_bn", "get_inception_v3", "get_vgg",
+           "get_googlenet"]

@@ -535,21 +535,26 @@ def onehot_encode(indices, out) -> NDArray:
 
 
 def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3, mean=None):
-    """Decode a JPEG/PNG buffer (reference: _imdecode NDArray function,
-    ndarray.cc:796+; OpenCV there, PIL here)."""
-    from .image_backend import decode_image
-
-    img = decode_image(str_img, channels)
-    if clip_rect and any(clip_rect):
-        x0, y0, x1, y1 = clip_rect
-        img = img[y0:y1, x0:x1]
-    arr = array(img)
-    if mean is not None:
-        arr = arr - mean
+    """Decode a JPEG/PNG buffer via the registered ``_imdecode`` op
+    (reference python/mxnet/ndarray.py imdecode -> _imdecode NDArray
+    function, ndarray.cc:796+): CHW float32 output, optional crop box and
+    CHW mean subtraction — the reference's layout contract."""
+    if isinstance(str_img, NDArray):
+        buf = str_img
+    else:
+        data = str_img if isinstance(str_img, (bytes, bytearray)) \
+            else bytes(str_img)
+        buf = array(np.frombuffer(data, dtype=np.uint8))
+    mean_arr = mean if mean is not None else array(
+        np.zeros((0,), np.float32))
+    x0, y0, x1, y1 = clip_rect if clip_rect else (0, 0, 0, 0)
+    res = _invoke("_imdecode", (mean_arr, buf),
+                  {"index": index, "x0": x0, "y0": y0, "x1": x1, "y1": y1,
+                   "c": channels, "size": 0})
     if out is not None:
-        out[:] = arr
+        out[:] = res
         return out
-    return arr
+    return res
 
 
 def waitall():

@@ -65,9 +65,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        kblk = k_ref[0].astype(jnp.float32)
-        vblk = v_ref[0].astype(jnp.float32)
+        # dots stay in the input dtype (bf16 on TPU -> MXU) with f32
+        # accumulation; only the softmax state is f32
+        q = q_ref[0]
+        kblk = k_ref[0]
+        vblk = v_ref[0]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -80,7 +82,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         corr = jnp.exp(m - m_new)
         l_scr[...] = l_scr[...] * corr + p.sum(axis=-1)
         acc_scr[...] = acc_scr[...] * corr[:, None] + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
+            p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = m_new
 
@@ -89,7 +91,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = l_scr[...]
         lsafe = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_scr[...] / lsafe[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[...] + jnp.log(lsafe)
+        lse_ref[0, 0] = m_scr[...] + jnp.log(lsafe)
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -114,13 +116,17 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 
     kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, nk=nk,
                                scale=scale, causal=causal)
+    # lse carries a singleton middle dim so its block's trailing dims
+    # (1, bq) satisfy the Mosaic tiling rule (second-to-last equals the
+    # array dim, last divisible by 128); squeezed before returning
     try:
         vma = jax.typeof(qt).vma
         out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype, vma=vma),
-                     jax.ShapeDtypeStruct((b * h, sq), jnp.float32, vma=vma)]
+                     jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32,
+                                          vma=vma)]
     except (AttributeError, TypeError):
         out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-                     jax.ShapeDtypeStruct((b * h, sq), jnp.float32)]
+                     jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32)]
     o, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // bq, nk),
@@ -131,7 +137,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, 1, bq), lambda bh, i, j: (bh, 0, i)),
         ],
         out_shape=out_shape,
         scratch_shapes=[
@@ -141,7 +147,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse
+    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse.reshape(b * h, sq)
 
 
 # ---------------------------------------------------------------------------
@@ -166,12 +172,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        kblk = k_ref[0].astype(jnp.float32)
-        vblk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        q = q_ref[0]
+        kblk = k_ref[0]
+        vblk = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -181,7 +187,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(kblk.dtype)
         acc_scr[...] += jax.lax.dot_general(
             ds, kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -210,12 +216,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        kblk = k_ref[0].astype(jnp.float32)
-        vblk = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        q = q_ref[0]
+        kblk = k_ref[0]
+        vblk = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -224,11 +230,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(q_pos >= k_pos, s, _NEG)
         p = jnp.exp(s - lse[:, None])  # [bq, bk]
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bk, d]
         dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk_scr[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bk, d]
@@ -255,9 +261,13 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     dot = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     ot = o.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    # delta_i = rowsum(dO ∘ O): cheap elementwise+reduce, fused by XLA
+    # delta_i = rowsum(dO ∘ O): cheap elementwise+reduce, fused by XLA.
+    # lse/delta get a singleton middle dim so their (1, 1, bq) blocks pass
+    # the Mosaic trailing-dims tiling rule (see _flash_forward).
     delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
                     axis=-1)
+    lse3 = lse.reshape(b * h, 1, sq)
+    delta3 = delta.reshape(b * h, 1, sq)
 
     from jax.experimental.pallas import tpu as pltpu
 
@@ -279,14 +289,14 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),   # k
             pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),   # v
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),   # do
-            pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i)),         # lse
-            pl.BlockSpec((1, bq), lambda bh, i, j: (bh, i)),         # delta
+            pl.BlockSpec((1, 1, bq), lambda bh, i, j: (bh, 0, i)),   # lse
+            pl.BlockSpec((1, 1, bq), lambda bh, i, j: (bh, 0, i)),   # delta
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=sds((b * h, sq, d), q.dtype),
         scratch_shapes=[scratch((bq, d))],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, bq=bq, bk=bk, nq=nq, scale=scale,
@@ -297,8 +307,8 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
             pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),   # k
             pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),   # v
             pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),   # do
-            pl.BlockSpec((1, bq), lambda bh, j, i: (bh, i)),         # lse
-            pl.BlockSpec((1, bq), lambda bh, j, i: (bh, i)),         # delta
+            pl.BlockSpec((1, 1, bq), lambda bh, j, i: (bh, 0, i)),   # lse
+            pl.BlockSpec((1, 1, bq), lambda bh, j, i: (bh, 0, i)),   # delta
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
@@ -308,7 +318,7 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
                    sds((b * h, sk, d), v.dtype)],
         scratch_shapes=[scratch((bk, d)), scratch((bk, d))],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, lse3, delta3)
 
     unflat = lambda t, s: t.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)

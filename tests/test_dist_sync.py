@@ -3,12 +3,21 @@ processes that rendezvous via jax.distributed and assert sync-sum semantics
 (reference: tests/nightly/test_all.sh:37 running
 ``launch.py -n 4 python dist_sync_kvstore.py``)."""
 import os
+import re
 import subprocess
 import sys
 
 import pytest
 
 ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def _ok_ranks(stdout, worker_name):
+    """Distinct worker ranks that reported OK. Robust to concurrent workers
+    interleaving their stdout writes onto one line (print() issues the text
+    and the newline as separate write()s), which breaks per-line counting."""
+    return {int(m.group(1)) for m in
+            re.finditer(r"%s (\d+)/\d+ OK" % re.escape(worker_name), stdout)}
 
 
 def _run_launcher(nworkers, script, timeout=240):
@@ -26,9 +35,9 @@ def _run_launcher(nworkers, script, timeout=240):
 
 def test_dist_sync_kvstore_4_workers():
     res = _run_launcher(4, os.path.join(ROOT, "tests", "dist_sync_worker.py"))
-    ok_lines = [l for l in res.stdout.splitlines() if "OK" in l]
     assert res.returncode == 0, (res.stdout[-3000:], res.stderr[-3000:])
-    assert len(ok_lines) == 4, res.stdout
+    assert _ok_ranks(res.stdout, "dist_sync_worker") == {0, 1, 2, 3}, \
+        res.stdout
 
 
 def test_dist_sync_in_process_single_worker():
@@ -49,15 +58,15 @@ def test_dist_sync_in_process_single_worker():
 
 def test_dist_sync_module_training_4_workers():
     res = _run_launcher(4, os.path.join(ROOT, "tests", "dist_train_worker.py"))
-    ok_lines = [l for l in res.stdout.splitlines() if "OK" in l]
     assert res.returncode == 0, (res.stdout[-3000:], res.stderr[-3000:])
-    assert len(ok_lines) == 4, res.stdout
+    assert _ok_ranks(res.stdout, "dist_train_worker") == {0, 1, 2, 3}, \
+        res.stdout
 
 
 def test_dist_fused_global_mesh_4_workers():
     """The fused path: fwd+bwd+psum+update as ONE program over a mesh
     spanning 4 processes, params matching a single-process oracle."""
     res = _run_launcher(4, os.path.join(ROOT, "tests", "dist_fused_worker.py"))
-    ok_lines = [l for l in res.stdout.splitlines() if "OK" in l]
     assert res.returncode == 0, (res.stdout[-3000:], res.stderr[-3000:])
-    assert len(ok_lines) == 4, res.stdout
+    assert _ok_ranks(res.stdout, "dist_fused_worker") == {0, 1, 2, 3}, \
+        res.stdout

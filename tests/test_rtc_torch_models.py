@@ -46,6 +46,20 @@ def test_torch_bridge():
     np.testing.assert_allclose(out2.asnumpy(), [[2, 4], [9, 10]])
 
 
+def test_inception_v3_builder():
+    net = mx.models.get_inception_v3(num_classes=10)
+    # canonical 299x299 input shape resolves through the whole stack
+    _, out_shapes, _ = net.infer_shape(data=(4, 3, 299, 299))
+    assert out_shapes[0] == (4, 10)
+    # small spatial size for a fast CPU forward (global_pool absorbs it)
+    ex = net.simple_bind(mx.cpu(), data=(2, 3, 96, 96),
+                         softmax_label=(2,), grad_req="null")
+    ex.forward(is_train=False)
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-4)
+
+
 @pytest.mark.parametrize("builder,kwargs,n_args", [
     ("get_vgg", {"num_layers": 11, "num_classes": 10}, None),
     ("get_googlenet", {"num_classes": 10}, None),
