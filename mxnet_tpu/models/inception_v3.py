@@ -118,7 +118,6 @@ def get_inception_v3(num_classes=1000):
     net = _block_e(net, "max", "mixed10")
     net = sym.Pooling(data=net, kernel=(8, 8), global_pool=True,
                       pool_type="avg", name="global_pool")
-    net = sym.Dropout(data=net, p=0.5, name="drop")
     net = sym.Flatten(data=net, name="flatten")
     net = sym.FullyConnected(data=net, num_hidden=num_classes, name="fc1")
     return sym.SoftmaxOutput(data=net, name="softmax")

@@ -552,7 +552,13 @@ def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3, mea
                   {"index": index, "x0": x0, "y0": y0, "x1": x1, "y1": y1,
                    "c": channels, "size": 0})
     if out is not None:
-        out[:] = res
+        # reference Imdecode writes into slice ``index`` of a 4-D batch
+        # buffer (ndarray.cc: ret->Slice(index, index+1)); a 3-D out is
+        # filled whole
+        if out.ndim == 4:
+            out[index:index + 1] = res.reshape((1,) + res.shape)
+        else:
+            out[:] = res
         return out
     return res
 

@@ -1,0 +1,85 @@
+"""WarpCTC loss op — plugin-op parity.
+
+Capability parity with the reference's warp-ctc plugin
+(/root/reference/plugin/warpctc/warpctc-inl.h): a loss-output layer whose
+forward is softmax over the alphabet and whose backward is the CTC
+gradient w.r.t. the pre-softmax activations, with the head gradient
+ignored (it IS the loss). The reference links Baidu's warp-ctc CUDA/C++
+library; here the CTC recursion is optax's pure-JAX dynamic program, so
+it fuses into the jitted step like every other op.
+
+Contract (warpctc-inl.h:66-135):
+  * data: 2-D ``(input_length * batch, alphabet)``, time-major rows;
+  * label: ``batch * label_length`` ints, blank = 0; zeros are stripped
+    to recover each sample's true label sequence (:85-98);
+  * output: ``softmax(data)``; gradient: CTC grad, out_grad ignored.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from .param import Param
+
+
+def _ctc_losses(data, label, input_length, label_length):
+    """Per-sample CTC losses. data: (T*N, P) time-major; label: (N, L)."""
+    tn, p = data.shape
+    n = tn // input_length
+    logits = data.reshape(input_length, n, p).transpose(1, 0, 2)  # (N, T, P)
+    # strip blanks (0) preserving order: stable argsort moves zeros to the
+    # tail, matching the plugin's removeBlank compaction (:101-110)
+    lab = label.reshape(n, label_length).astype(jnp.int32)
+    order = jnp.argsort(lab == 0, axis=1, stable=True)
+    lab = jnp.take_along_axis(lab, order, axis=1)
+    label_pad = (lab == 0).astype(jnp.float32)
+    logit_pad = jnp.zeros(logits.shape[:2], jnp.float32)
+    import optax
+
+    return optax.ctc_loss(logits.astype(jnp.float32), logit_pad, lab,
+                          label_pad, blank_id=0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _warpctc_impl(data, label, input_length, label_length):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _warpctc_fwd(data, label, input_length, label_length):
+    return jax.nn.softmax(data, axis=-1), (data, label)
+
+
+def _warpctc_bwd(input_length, label_length, res, ct):
+    del ct  # loss op: head gradient ignored (warpctc-inl.h Backward)
+    data, label = res
+    grad = jax.grad(
+        lambda d: jnp.sum(_ctc_losses(d, label, input_length,
+                                      label_length)))(
+        data.astype(jnp.float32))
+    return grad.astype(data.dtype), jnp.zeros_like(label)
+
+
+_warpctc_impl.defvjp(_warpctc_fwd, _warpctc_bwd)
+
+
+def _warpctc_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    n = d[0] // int(attrs["input_length"])
+    lshape = in_shapes[1] if in_shapes[1] is not None \
+        else (n, int(attrs["label_length"]))
+    return [tuple(d), tuple(lshape)], [tuple(d)], []
+
+
+@register("WarpCTC", inputs=("data", "label"),
+          params={"label_length": Param(int, required=True),
+                  "input_length": Param(int, required=True)},
+          infer_shape=_warpctc_infer, no_grad_inputs=("label",),
+          hint="warpctc")
+def _warpctc(opctx, attrs, data, label):
+    return _warpctc_impl(data, label, int(attrs["input_length"]),
+                         int(attrs["label_length"]))

@@ -72,6 +72,10 @@ _UNARY = {
     "tan": jnp.tan,
     "tanh": jnp.tanh,
     "relu": jax.nn.relu,
+    # transformer-era additions (post-0.9 mxnet names; the model zoo's
+    # transformer family uses gelu)
+    "erf": lambda x: jax.scipy.special.erf(x),
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
 }
 
 

@@ -440,6 +440,46 @@ def _instance_norm(opctx, attrs, data, gamma, beta):
     return out * gamma.reshape(bshape) + beta.reshape(bshape)
 
 
+def _layer_norm_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    n_out = 3 if attrs.get("output_mean_var") else 1
+    if d is None:
+        return in_shapes, [None] * n_out, []
+    axis = int(attrs.get("axis", -1))
+    n = d[axis if axis >= 0 else len(d) + axis]
+    outs = [tuple(d)]
+    if attrs.get("output_mean_var"):
+        red = tuple(v for i, v in enumerate(d)
+                    if i != (axis if axis >= 0 else len(d) + axis))
+        outs += [red, red]
+    return [tuple(d), (n,), (n,)], outs, []
+
+
+@register("LayerNorm", inputs=("data", "gamma", "beta"),
+          params={"axis": Param(int, -1), "eps": Param(float, 1e-5),
+                  "output_mean_var": Param(bool, False)},
+          num_outputs=lambda attrs: 3 if attrs.get("output_mean_var") else 1,
+          infer_shape=_layer_norm_infer, hint="layernorm")
+def _layer_norm(opctx, attrs, data, gamma, beta):
+    """Layer normalization over one axis (post-0.9 mxnet op name; the
+    transformer model family's normalization). Statistics in f32 even for
+    bf16 activations, like BatchNorm above."""
+    eps = attrs.get("eps", 1e-5)
+    axis = int(attrs.get("axis", -1))
+    x = data.astype(jnp.float32)
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    norm = ((x - mean) * lax.rsqrt(var + eps)).astype(data.dtype)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    out = norm * gamma.reshape(bshape).astype(data.dtype) \
+        + beta.reshape(bshape).astype(data.dtype)
+    if attrs.get("output_mean_var"):
+        return (out, jnp.squeeze(mean, axis).astype(data.dtype),
+                jnp.squeeze(var, axis).astype(data.dtype))
+    return out
+
+
 @register("L2Normalization",
           params={"eps": Param(float, 1e-10),
                   "mode": Param(str, "instance", enum=("instance", "spatial", "channel"))},

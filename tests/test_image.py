@@ -54,6 +54,19 @@ def test_imdecode_imresize_round_trip():
     assert r.shape == (12, 8, 3)
 
 
+def test_nd_imdecode_batch_out_slice():
+    """nd.imdecode(out=4-D, index=i) fills ONLY slice i (reference
+    ndarray.cc Imdecode: ret->Slice(index, index+1))."""
+    img = _make_img(6, 5)
+    buf = image_backend.encode_image(img, ".png")
+    out = nd.zeros((3, 3, 6, 5))
+    nd.imdecode(buf, out=out, index=1)
+    got = out.asnumpy()
+    chw = img.transpose(2, 0, 1).astype(np.float32)
+    np.testing.assert_allclose(got[1], chw)
+    assert not got[0].any() and not got[2].any()
+
+
 def test_cv_ops_imperative():
     img = _make_img(10, 10)
     buf = np.frombuffer(image_backend.encode_image(img, ".png"), np.uint8)

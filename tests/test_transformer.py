@@ -1,0 +1,92 @@
+"""Transformer LM model family: builder shapes, Module training through
+the Pallas flash-attention op, LayerNorm/gelu op parity."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_layer_norm_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6, 8).astype(np.float32)
+    g = rng.rand(8).astype(np.float32) + 0.5
+    b = rng.randn(8).astype(np.float32)
+    out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+                          eps=1e-5)
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5) * g + b
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    net = mx.sym.LayerNorm(mx.sym.Variable("data"), mx.sym.Variable("gamma"),
+                           mx.sym.Variable("beta"))
+    rng = np.random.RandomState(1)
+    check_numeric_gradient(
+        net, {"data": rng.randn(3, 7).astype(np.float32),
+              "gamma": rng.rand(7).astype(np.float32) + 0.5,
+              "beta": rng.randn(7).astype(np.float32)},
+        numeric_eps=1e-3, rtol=1e-2, atol=1e-2)
+
+
+def test_layer_norm_output_mean_var():
+    rng = np.random.RandomState(2)
+    x = rng.randn(3, 5).astype(np.float32)
+    net = mx.sym.LayerNorm(mx.sym.Variable("data"), mx.sym.Variable("gamma"),
+                           mx.sym.Variable("beta"), output_mean_var=True)
+    assert len(net.list_outputs()) == 3
+    ex = net.bind(mx.cpu(), {"data": mx.nd.array(x),
+                             "gamma": mx.nd.ones((5,)),
+                             "beta": mx.nd.zeros((5,))})
+    ex.forward(is_train=False)
+    out, mean, var = (o.asnumpy() for o in ex.outputs)
+    np.testing.assert_allclose(mean, x.mean(-1), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(var, x.var(-1), rtol=1e-4, atol=1e-5)
+
+
+def test_gelu_erf_ops():
+    x = np.linspace(-3, 3, 13).astype(np.float32)
+    g = mx.nd.gelu(mx.nd.array(x)).asnumpy()
+    from scipy.special import erf as sp_erf
+    ref = 0.5 * x * (1 + sp_erf(x / np.sqrt(2)))
+    np.testing.assert_allclose(g, ref, rtol=1e-3, atol=1e-4)
+    e = mx.nd.erf(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(e, sp_erf(x), rtol=1e-5, atol=1e-6)
+
+
+def test_transformer_shapes():
+    net = mx.models.get_transformer_lm(vocab_size=100, num_layers=2,
+                                       num_heads=4, hidden=64, seq_len=16)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(8, 16),
+                                                softmax_label=(8, 16))
+    assert out_shapes[0] == (8 * 16, 100)
+    names = net.list_arguments()
+    assert "pos_embed_weight" in names and "tok_embed_weight" in names
+
+
+def test_transformer_lm_learns_next_token():
+    """End-to-end: Module.fit on a deterministic next-token task reaches
+    ~perfect accuracy — exercises Embedding/LayerNorm/gelu/flash-attention
+    fwd+bwd through the fused step."""
+    V, S, B = 50, 32, 4
+    net = mx.models.get_transformer_lm(vocab_size=V, num_layers=2,
+                                       num_heads=4, hidden=64, seq_len=S)
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, V, size=(64, S)).astype(np.float32)
+    Y = (X + 1) % V
+    it = mx.io.NDArrayIter(X, Y, batch_size=B, label_name="softmax_label")
+    mod = mx.mod.Module(net, label_names=("softmax_label",))
+    mod.fit(it, num_epoch=6, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-2})
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        out = mod.get_outputs()[0].asnumpy()
+        lab = batch.label[0].asnumpy().reshape(-1)
+        correct += (out.argmax(-1) == lab).sum()
+        total += lab.size
+    assert correct / total > 0.9, correct / total

@@ -24,7 +24,8 @@ def main():
     ap.add_argument("--head-dim", type=int, default=128)
     ap.add_argument("--seq", type=int, default=16384)
     ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--block", type=int, default=128)
+    ap.add_argument("--block-q", type=int, default=512)
+    ap.add_argument("--block-k", type=int, default=1024)
     cli = ap.parse_args()
 
     import jax
@@ -43,8 +44,8 @@ def main():
     v = jax.random.normal(key, (b, s, h, d), dt) * 0.1
 
     def loss(q, k, v):
-        o = flash_attention(q, k, v, causal=True, block_q=cli.block,
-                            block_k=cli.block)
+        o = flash_attention(q, k, v, causal=True, block_q=cli.block_q,
+                            block_k=cli.block_k)
         return jnp.mean(o.astype(jnp.float32) ** 2)
 
     step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
