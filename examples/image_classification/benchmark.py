@@ -1,0 +1,72 @@
+"""Training-throughput sweep across networks and batch sizes.
+
+Capability parity with the reference's sweep harness
+(/root/reference/example/image-classification/benchmark.py), redesigned
+for the mesh world: instead of re-invoking train_imagenet.py over ssh for
+each gpu count, each config runs the fused Module train step in-process
+(synthetic data, the same path as ``train_imagenet.py --benchmark 1``)
+and the result is one JSON line per config.
+
+Usage:
+  python benchmark.py --networks resnet-50:256:224 alexnet:512:224 \
+      [--dtype bfloat16] [--num-steps 30]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from examples.image_classification.common import fit  # noqa: E402
+from examples.image_classification.train_imagenet import get_network  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--networks", nargs="+",
+                   default=["resnet-50:256:224", "inception-bn:256:224",
+                            "alexnet:512:224"],
+                   help="configs as network:batch_size:image_size")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--num-steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--kv-store", default="local")
+    return p.parse_args()
+
+
+def run_config(spec, cli):
+    name, batch, size = spec.split(":")
+    parser = argparse.ArgumentParser()
+    fit.add_fit_args(parser)
+    args = parser.parse_args([
+        "--network", name, "--num-classes", str(cli.num_classes),
+        "--image-shape", "3,%s,%s" % (size, size),
+        "--batch-size", batch, "--dtype", cli.dtype,
+        "--kv-store", cli.kv_store, "--benchmark", "1"])
+    net = get_network(args)
+    stats = fit.benchmark(args, net, num_steps=cli.num_steps,
+                          warmup=cli.warmup)
+    return {"network": name, "batch_size": int(batch),
+            "image_size": int(size), "dtype": cli.dtype,
+            "img_per_sec": round(stats["img_per_sec"], 2),
+            "step_time_ms": round(stats["step_time_ms"], 2)}
+
+
+def main():
+    cli = parse_args()
+    for spec in cli.networks:
+        # SystemExit included: a malformed numeric field makes the inner
+        # argparse sys.exit, which must not abort the remaining sweep
+        try:
+            print(json.dumps(run_config(spec, cli)), flush=True)
+        except (Exception, SystemExit) as e:
+            print(json.dumps({"network": spec, "error": str(e)[:300]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

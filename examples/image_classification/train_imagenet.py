@@ -60,6 +60,10 @@ def get_network(args):
                                  num_layers=num_layers)
     if name == "googlenet":
         return mx.models.get_googlenet(num_classes=args.num_classes)
+    if name == "lenet":
+        return mx.models.get_lenet(num_classes=args.num_classes)
+    if name == "mlp":
+        return mx.models.get_mlp(num_classes=args.num_classes)
     raise ValueError("unknown network %s" % name)
 
 

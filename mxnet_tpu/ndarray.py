@@ -408,6 +408,13 @@ def _invoke(op_name: str, args, kwargs):
             if isinstance(holder, NDArray):
                 holder._set(new)
     results = [NDArray(o, ctx) for o in outs]
+    from .base import env as _env
+
+    if _env("MXNET_ENGINE_TYPE") == "NaiveEngine":
+        # NaiveEngine debug contract: synchronous execution, block after
+        # every op (reference src/engine/naive_engine.cc — executes on push)
+        for r in results:
+            r._data.block_until_ready()
     if out is not None:
         outs_t = out if isinstance(out, (list, tuple)) else [out]
         for dst, src in zip(outs_t, results):
