@@ -1,0 +1,81 @@
+/*
+ * Core C ABI — NDArray CRUD, serialization, op registry, imperative
+ * invoke.  The load-bearing subset of the reference's flat C API
+ * (/root/reference/include/mxnet/c_api.h: MXNDArrayCreateEx :114,
+ * MXNDArraySyncCopy{From,To}CPU, MXNDArraySave/Load :211+,
+ * MXListAllOpNames, MXImperativeInvoke c_api_ndarray.cc:323) — the
+ * boundary that made the reference's non-Python frontends possible.
+ *
+ * Conventions: every function returns 0 on success, -1 on failure with
+ * the message readable via MXTPUGetLastError() (thread-local).  Handles
+ * are opaque.  Returned arrays (shapes, names, handle lists) are owned
+ * by the library and valid until the next call on the same thread.
+ *
+ * dtype flags are the reference's mshadow enum: 0=float32 1=float64
+ * 2=float16 3=uint8 4=int32 5=int8 6=int64.
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+typedef uint32_t mx_uint;
+
+const char* MXTPUGetLastError(void);
+
+/* Create a zero-filled array. dev_type: 1=cpu, 2=gpu/accelerator. */
+int MXTPUNDArrayCreate(const mx_uint* shape, mx_uint ndim, int dev_type,
+                       int dev_id, int dtype_flag, NDArrayHandle* out);
+
+int MXTPUNDArrayFree(NDArrayHandle handle);
+
+/* *out_data stays owned by the library (valid until the next call on
+ * this thread). */
+int MXTPUNDArrayGetShape(NDArrayHandle handle, mx_uint* out_ndim,
+                         const mx_uint** out_data);
+
+int MXTPUNDArrayGetDType(NDArrayHandle handle, int* out_dtype);
+
+/* Synchronous host<->device copies; nbytes must equal the array's byte
+ * size in its own dtype. */
+int MXTPUNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                                size_t nbytes);
+int MXTPUNDArraySyncCopyToCPU(NDArrayHandle handle, void* data,
+                              size_t nbytes);
+
+/* Block until all pending async work completes (engine WaitForAll). */
+int MXTPUNDArrayWaitAll(void);
+
+/* Save arrays to a reference-format .params file.  keys may be NULL for
+ * a nameless list container. */
+int MXTPUNDArraySave(const char* fname, mx_uint num_args,
+                     NDArrayHandle* args, const char** keys);
+
+/* Load a .params file.  *out_names has *out_name_size entries (0 for a
+ * list container). */
+int MXTPUNDArrayLoad(const char* fname, mx_uint* out_size,
+                     NDArrayHandle** out_arr, mx_uint* out_name_size,
+                     const char*** out_names);
+
+/* All registered operator names. */
+int MXTPUListAllOpNames(mx_uint* out_size, const char*** out_array);
+
+/* Invoke a registered op imperatively.  Attr values are strings, parsed
+ * by the op's declarative parameter specs (the attr_parser contract).
+ * *outputs is library-owned. */
+int MXTPUImperativeInvoke(const char* op_name, int num_inputs,
+                          NDArrayHandle* inputs, int* num_outputs,
+                          NDArrayHandle** outputs, int num_params,
+                          const char** param_keys, const char** param_vals);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_API_H_ */

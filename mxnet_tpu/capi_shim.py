@@ -12,9 +12,34 @@ import threading
 
 import numpy as np
 
-_registry = {}
-_next_id = [1]
-_lock = threading.Lock()
+class _HandleRegistry:
+    """Integer-handle table — the opaque-handle pattern all C-ABI objects
+    share (predictors, NDArrays)."""
+
+    def __init__(self):
+        self._items = {}
+        self._next = 1
+        self._lock = threading.Lock()
+
+    def put(self, obj):
+        with self._lock:
+            hid = self._next
+            self._next += 1
+            self._items[hid] = obj
+        return hid
+
+    def get(self, hid, kind):
+        obj = self._items.get(hid)
+        if obj is None:
+            raise KeyError("invalid %s handle %d" % (kind, hid))
+        return obj
+
+    def pop(self, hid):
+        with self._lock:
+            self._items.pop(hid, None)
+
+
+_predictors = _HandleRegistry()
 
 
 def create(symbol_json, params_bytes, input_keys, input_shapes, dev_type):
@@ -39,18 +64,11 @@ def create(symbol_json, params_bytes, input_keys, input_shapes, dev_type):
     shapes = {k: tuple(int(d) for d in s)
               for k, s in zip(input_keys, input_shapes)}
     pred = Predictor(symbol_json, params, shapes, ctx=ctx)
-    with _lock:
-        hid = _next_id[0]
-        _next_id[0] += 1
-        _registry[hid] = pred
-    return hid
+    return _predictors.put(pred)
 
 
 def _get(hid):
-    pred = _registry.get(hid)
-    if pred is None:
-        raise KeyError("invalid predictor handle %d" % hid)
-    return pred
+    return _predictors.get(hid, "predictor")
 
 
 def set_input(hid, key, data_bytes, shape):
@@ -85,13 +103,101 @@ def reshape(hid, input_keys, input_shapes):
     pred = _get(hid)
     new = pred.reshape({k: tuple(int(d) for d in s)
                         for k, s in zip(input_keys, input_shapes)})
-    with _lock:
-        hid2 = _next_id[0]
-        _next_id[0] += 1
-        _registry[hid2] = new
-    return hid2
+    return _predictors.put(new)
 
 
 def free(hid):
-    with _lock:
-        _registry.pop(hid, None)
+    _predictors.pop(hid)
+
+
+# ---------------------------------------------------------------------------
+# Core NDArray / op C API backing (src/c_api.cc — the reference's
+# c_api.cc NDArray CRUD + MXImperativeInvoke + MXListAllOpNames subset).
+# Same integer-handle registry pattern as the predictor above.
+# ---------------------------------------------------------------------------
+
+_ndarrays = _HandleRegistry()
+
+
+def _nd_put(arr):
+    return _ndarrays.put(arr)
+
+
+def _nd_get(hid):
+    return _ndarrays.get(hid, "NDArray")
+
+
+def nd_create(shape, dev_type, dev_id, dtype_flag):
+    from . import context as ctx_mod
+    from . import ndarray as nd
+    from .ndarray import _FLAG_TYPE
+
+    ctx = ctx_mod.Context("gpu" if dev_type == 2 else "cpu", dev_id)
+    return _nd_put(nd.zeros(tuple(int(d) for d in shape),
+                            ctx=ctx, dtype=_FLAG_TYPE[dtype_flag]))
+
+
+def nd_free(hid):
+    _ndarrays.pop(hid)
+
+
+def nd_shape(hid):
+    return tuple(int(d) for d in _nd_get(hid).shape)
+
+
+def nd_dtype(hid):
+    from .ndarray import _TYPE_FLAG
+
+    return _TYPE_FLAG[str(np.dtype(_nd_get(hid).dtype))]
+
+
+def nd_copy_from(hid, data_bytes):
+    arr = _nd_get(hid)
+    src = np.frombuffer(data_bytes, dtype=arr.dtype).reshape(arr.shape)
+    arr[:] = src
+
+
+def nd_copy_to(hid):
+    return _nd_get(hid).asnumpy().tobytes()
+
+
+def nd_wait_all():
+    from . import ndarray as nd
+
+    nd.waitall()
+
+
+def nd_save(fname, hids, keys):
+    from . import ndarray as nd
+
+    arrs = [_nd_get(h) for h in hids]
+    nd.save(fname, dict(zip(keys, arrs)) if keys else arrs)
+
+
+def nd_load(fname):
+    """-> (handles, names); names empty for list containers."""
+    from . import ndarray as nd
+
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        return [_nd_put(data[k]) for k in names], names
+    return [_nd_put(a) for a in data], []
+
+
+def list_op_names():
+    from .ops import list_ops
+
+    return sorted(list_ops())
+
+
+def nd_invoke(op_name, in_hids, keys, vals):
+    """MXImperativeInvoke: attrs arrive as strings; the op's declarative
+    Param specs parse them (the reference's attr_parser contract)."""
+    from .ndarray import NDArray, _invoke
+
+    inputs = [_nd_get(h) for h in in_hids]
+    kwargs = dict(zip(keys, vals))
+    res = _invoke(op_name, tuple(inputs), kwargs)
+    outs = res if isinstance(res, (list, tuple)) else [res]
+    return [_nd_put(o) for o in outs if isinstance(o, NDArray)]

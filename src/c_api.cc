@@ -1,0 +1,258 @@
+// Core C ABI implementation — NDArray CRUD + serialization + op invoke
+// over the embedded interpreter (see include/mxtpu/c_api.h and the design
+// note at the top of c_predict_api.cc).  Python side:
+// mxnet_tpu/capi_shim.py (nd_* functions).
+#include "capi_common.h"
+
+#include <cstdarg>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+using mx_uint = uint32_t;
+using mxtpu_capi::GIL;
+using mxtpu_capi::ensure_python;
+using mxtpu_capi::set_error;
+using mxtpu_capi::set_error_from_python;
+using mxtpu_capi::shim;
+
+namespace {
+
+// NDArray handles are heap longs carrying the shim registry id.
+struct NDHandle {
+  long long hid;
+};
+
+// Per-thread backing for returned arrays (reference c_api uses
+// thread-local return stores the same way).
+thread_local std::vector<mx_uint> t_shape;
+thread_local std::vector<std::string> t_names_store;
+thread_local std::vector<const char*> t_names;
+thread_local std::vector<void*> t_handles;
+
+PyObject* call_shim(const char* fn, const char* fmt, ...) {
+  PyObject* mod = shim();
+  if (!mod) {
+    set_error_from_python();
+    return nullptr;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject* callable = PyObject_GetAttrString(mod, fn);
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  PyObject* res = nullptr;
+  if (callable && args) res = PyObject_CallObject(callable, args);
+  Py_XDECREF(args);
+  Py_XDECREF(callable);
+  if (!res) set_error_from_python();
+  return res;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXTPUNDArrayCreate(const mx_uint* shape, mx_uint ndim, int dev_type,
+                       int dev_id, int dtype_flag, void** out) {
+  ensure_python();
+  GIL gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  PyObject* res =
+      call_shim("nd_create", "(Oiii)", shp, dev_type, dev_id, dtype_flag);
+  Py_DECREF(shp);
+  if (!res) return -1;
+  auto* h = new NDHandle{PyLong_AsLongLong(res)};
+  Py_DECREF(res);
+  *out = h;
+  return 0;
+}
+
+int MXTPUNDArrayFree(void* handle) {
+  auto* h = static_cast<NDHandle*>(handle);
+  if (!h) return 0;
+  {
+    GIL gil;
+    PyObject* res = call_shim("nd_free", "(L)", h->hid);
+    if (res) Py_DECREF(res);
+    else PyErr_Clear();
+  }
+  delete h;
+  return 0;
+}
+
+int MXTPUNDArrayGetShape(void* handle, mx_uint* out_ndim,
+                         const mx_uint** out_data) {
+  auto* h = static_cast<NDHandle*>(handle);
+  GIL gil;
+  PyObject* res = call_shim("nd_shape", "(L)", h->hid);
+  if (!res) return -1;
+  Py_ssize_t n = PyTuple_Size(res);
+  t_shape.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    t_shape[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(res, i)));
+  }
+  Py_DECREF(res);
+  *out_ndim = static_cast<mx_uint>(n);
+  *out_data = t_shape.data();
+  return 0;
+}
+
+int MXTPUNDArrayGetDType(void* handle, int* out_dtype) {
+  auto* h = static_cast<NDHandle*>(handle);
+  GIL gil;
+  PyObject* res = call_shim("nd_dtype", "(L)", h->hid);
+  if (!res) return -1;
+  *out_dtype = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArraySyncCopyFromCPU(void* handle, const void* data,
+                                size_t nbytes) {
+  auto* h = static_cast<NDHandle*>(handle);
+  GIL gil;
+  PyObject* res = call_shim("nd_copy_from", "(Ly#)", h->hid,
+                            static_cast<const char*>(data),
+                            static_cast<Py_ssize_t>(nbytes));
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArraySyncCopyToCPU(void* handle, void* data, size_t nbytes) {
+  auto* h = static_cast<NDHandle*>(handle);
+  GIL gil;
+  PyObject* res = call_shim("nd_copy_to", "(L)", h->hid);
+  if (!res) return -1;
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
+    Py_DECREF(res);
+    set_error_from_python();
+    return -1;
+  }
+  if (static_cast<size_t>(len) != nbytes) {
+    Py_DECREF(res);
+    set_error("copy size mismatch: array has " + std::to_string(len) +
+              " bytes, caller asked for " + std::to_string(nbytes));
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayWaitAll(void) {
+  ensure_python();
+  GIL gil;
+  PyObject* res = call_shim("nd_wait_all", "()");
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArraySave(const char* fname, mx_uint num_args, void** args,
+                     const char** keys) {
+  GIL gil;
+  PyObject* hids = PyList_New(num_args);
+  PyObject* names = keys ? PyList_New(num_args) : PyList_New(0);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(hids, i, PyLong_FromLongLong(
+        static_cast<NDHandle*>(args[i])->hid));
+    if (keys) PyList_SET_ITEM(names, i, PyUnicode_FromString(keys[i]));
+  }
+  PyObject* res = call_shim("nd_save", "(sOO)", fname, hids, names);
+  Py_DECREF(hids);
+  Py_DECREF(names);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayLoad(const char* fname, mx_uint* out_size, void*** out_arr,
+                     mx_uint* out_name_size, const char*** out_names) {
+  ensure_python();
+  GIL gil;
+  PyObject* res = call_shim("nd_load", "(s)", fname);
+  if (!res) return -1;
+  PyObject* hids = PyTuple_GET_ITEM(res, 0);
+  PyObject* names = PyTuple_GET_ITEM(res, 1);
+  Py_ssize_t n = PyList_Size(hids);
+  t_handles.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    t_handles[i] =
+        new NDHandle{PyLong_AsLongLong(PyList_GET_ITEM(hids, i))};
+  }
+  Py_ssize_t nn = PyList_Size(names);
+  t_names_store.resize(nn);
+  t_names.resize(nn);
+  for (Py_ssize_t i = 0; i < nn; ++i) {
+    t_names_store[i] = PyUnicode_AsUTF8(PyList_GET_ITEM(names, i));
+    t_names[i] = t_names_store[i].c_str();
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(n);
+  *out_arr = t_handles.data();
+  *out_name_size = static_cast<mx_uint>(nn);
+  *out_names = t_names.data();
+  return 0;
+}
+
+int MXTPUListAllOpNames(mx_uint* out_size, const char*** out_array) {
+  ensure_python();
+  GIL gil;
+  PyObject* res = call_shim("list_op_names", "()");
+  if (!res) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  t_names_store.resize(n);
+  t_names.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    t_names_store[i] = PyUnicode_AsUTF8(PyList_GET_ITEM(res, i));
+    t_names[i] = t_names_store[i].c_str();
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = t_names.data();
+  return 0;
+}
+
+int MXTPUImperativeInvoke(const char* op_name, int num_inputs, void** inputs,
+                          int* num_outputs, void*** outputs, int num_params,
+                          const char** param_keys, const char** param_vals) {
+  ensure_python();
+  GIL gil;
+  PyObject* in = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyList_SET_ITEM(in, i, PyLong_FromLongLong(
+        static_cast<NDHandle*>(inputs[i])->hid));
+  }
+  PyObject* keys = PyList_New(num_params);
+  PyObject* vals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject* res = call_shim("nd_invoke", "(sOOO)", op_name, in, keys, vals);
+  Py_DECREF(in);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  if (!res) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  t_handles.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    t_handles[i] =
+        new NDHandle{PyLong_AsLongLong(PyList_GET_ITEM(res, i))};
+  }
+  Py_DECREF(res);
+  *num_outputs = static_cast<int>(n);
+  *outputs = t_handles.data();
+  return 0;
+}
+
+}  // extern "C"

@@ -12,68 +12,21 @@
 // we Py_Initialize) and inside an existing Python process (ctypes dlopen,
 // we just take the GIL).
 
-#define PY_SSIZE_T_CLEAN
-#include <Python.h>
+#include "capi_common.h"
 
 #include <cstdint>
 #include <cstring>
-#include <mutex>
 #include <string>
 #include <vector>
 
 using mx_uint = uint32_t;
+using mxtpu_capi::GIL;
+using mxtpu_capi::ensure_python;
+using mxtpu_capi::set_error;
+using mxtpu_capi::set_error_from_python;
+using mxtpu_capi::shim;
 
 namespace {
-
-thread_local std::string g_last_error;
-
-void set_error(const std::string& msg) { g_last_error = msg; }
-
-// Fetch the current Python exception into the error string.
-void set_error_from_python() {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  std::string msg = "python error";
-  if (value) {
-    if (PyObject* s = PyObject_Str(value)) {
-      if (const char* c = PyUnicode_AsUTF8(s)) msg = c;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-  set_error(msg);
-}
-
-std::once_flag g_init_once;
-bool g_we_initialized = false;
-
-void ensure_python() {
-  std::call_once(g_init_once, []() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      g_we_initialized = true;
-      // release the GIL acquired by Py_Initialize so PyGILState_Ensure
-      // below works uniformly from any thread
-      PyEval_SaveThread();
-    }
-  });
-}
-
-struct GIL {
-  PyGILState_STATE state;
-  GIL() { state = PyGILState_Ensure(); }
-  ~GIL() { PyGILState_Release(state); }
-};
-
-PyObject* shim() {
-  static PyObject* mod = nullptr;  // accessed under the GIL only
-  if (!mod) {
-    mod = PyImport_ImportModule("mxnet_tpu.capi_shim");
-  }
-  return mod;
-}
 
 struct Predictor {
   long long hid = 0;
@@ -105,8 +58,6 @@ PyObject* keys_to_py(mx_uint n, const char** keys) {
 }  // namespace
 
 extern "C" {
-
-const char* MXTPUGetLastError(void) { return g_last_error.c_str(); }
 
 int MXTPUPredCreate(const char* symbol_json, const void* param_bytes,
                     int param_size, int dev_type, int dev_id,

@@ -1,0 +1,55 @@
+// Shared C-ABI plumbing — see capi_common.h.
+#include "capi_common.h"
+
+#include <mutex>
+
+namespace mxtpu_capi {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    if (PyObject* s = PyObject_Str(value)) {
+      if (const char* c = PyUnicode_AsUTF8(s)) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+namespace {
+std::once_flag g_init_once;
+}
+
+void ensure_python() {
+  std::call_once(g_init_once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by Py_Initialize so PyGILState_Ensure
+      // works uniformly from any thread
+      PyEval_SaveThread();
+    }
+  });
+}
+
+PyObject* shim() {
+  static PyObject* mod = nullptr;  // accessed under the GIL only
+  if (!mod) {
+    mod = PyImport_ImportModule("mxnet_tpu.capi_shim");
+  }
+  return mod;
+}
+
+}  // namespace mxtpu_capi
+
+extern "C" const char* MXTPUGetLastError(void) {
+  return mxtpu_capi::g_last_error.c_str();
+}

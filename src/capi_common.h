@@ -1,0 +1,37 @@
+// Shared plumbing for the embeddable C ABI translation units
+// (c_predict_api.cc, c_api.cc): thread-local error string, interpreter
+// bring-up, GIL RAII, and the cached mxnet_tpu.capi_shim module.
+#ifndef MXTPU_SRC_CAPI_COMMON_H_
+#define MXTPU_SRC_CAPI_COMMON_H_
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <string>
+
+namespace mxtpu_capi {
+
+// Last error for this thread; read back via MXTPUGetLastError().
+extern thread_local std::string g_last_error;
+
+void set_error(const std::string& msg);
+
+// Fetch the current Python exception into the error string.
+void set_error_from_python();
+
+// Initialize CPython if this process has no interpreter yet (standalone C
+// embedder); a no-op when loaded into an existing Python process.
+void ensure_python();
+
+struct GIL {
+  PyGILState_STATE state;
+  GIL() { state = PyGILState_Ensure(); }
+  ~GIL() { PyGILState_Release(state); }
+};
+
+// The mxnet_tpu.capi_shim module (borrowed ref, cached; GIL held).
+PyObject* shim();
+
+}  // namespace mxtpu_capi
+
+#endif  // MXTPU_SRC_CAPI_COMMON_H_

@@ -147,3 +147,80 @@ def test_standalone_c_embedder(tmp_path):
     assert run.returncode == 0, (run.stdout, run.stderr)
     row = [float(v) for v in run.stdout.strip().split(",")]
     assert len(row) == 2 and abs(sum(row) - 1.0) < 1e-4  # softmax row
+
+
+def test_core_c_api_ndarray_and_invoke(tmp_path):
+    """Core C ABI (include/mxtpu/c_api.h): NDArray CRUD, imperative op
+    invoke with string attrs, .params save/load, op-name listing —
+    the reference c_api.cc NDArray surface driven via ctypes."""
+    lib = _build_lib()
+
+    # create a (2, 3) f32 array and fill it
+    shape = (ctypes.c_uint32 * 2)(2, 3)
+    h = ctypes.c_void_p()
+    assert lib.MXTPUNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(h)) == 0
+    src = np.arange(6, dtype=np.float32).reshape(2, 3)
+    assert lib.MXTPUNDArraySyncCopyFromCPU(
+        h, src.ctypes.data_as(ctypes.c_void_p), src.nbytes) == 0
+
+    # shape / dtype readback
+    ndim = ctypes.c_uint32()
+    sdata = ctypes.POINTER(ctypes.c_uint32)()
+    assert lib.MXTPUNDArrayGetShape(h, ctypes.byref(ndim),
+                                    ctypes.byref(sdata)) == 0
+    assert [sdata[i] for i in range(ndim.value)] == [2, 3]
+    dt = ctypes.c_int()
+    assert lib.MXTPUNDArrayGetDType(h, ctypes.byref(dt)) == 0
+    assert dt.value == 0  # float32 flag
+
+    # imperative invoke with a string attr: sum over axis 1
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    keys = (ctypes.c_char_p * 1)(b"axis")
+    vals = (ctypes.c_char_p * 1)(b"1")
+    ins = (ctypes.c_void_p * 1)(h)
+    assert lib.MXTPUImperativeInvoke(
+        b"sum", 1, ins, ctypes.byref(n_out), ctypes.byref(outs),
+        1, keys, vals) == 0, lib.MXTPUGetLastError()
+    assert n_out.value == 1
+    out = np.zeros(2, np.float32)
+    # outs[0] is a bare int; re-wrap so ctypes passes a full 64-bit pointer
+    assert lib.MXTPUNDArraySyncCopyToCPU(
+        ctypes.c_void_p(outs[0]), out.ctypes.data_as(ctypes.c_void_p),
+        out.nbytes) == 0
+    np.testing.assert_allclose(out, src.sum(axis=1))
+
+    # save named, load back, values survive
+    fname = str(tmp_path / "blob.params").encode()
+    names = (ctypes.c_char_p * 1)(b"w",)
+    assert lib.MXTPUNDArraySave(fname, 1, ins, names) == 0
+    n_arr = ctypes.c_uint32()
+    arrs = ctypes.POINTER(ctypes.c_void_p)()
+    n_names = ctypes.c_uint32()
+    out_names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXTPUNDArrayLoad(fname, ctypes.byref(n_arr),
+                                ctypes.byref(arrs), ctypes.byref(n_names),
+                                ctypes.byref(out_names)) == 0
+    assert n_arr.value == 1 and n_names.value == 1
+    assert out_names[0] == b"w"
+    back = np.zeros((2, 3), np.float32)
+    assert lib.MXTPUNDArraySyncCopyToCPU(
+        ctypes.c_void_p(arrs[0]), back.ctypes.data_as(ctypes.c_void_p),
+        back.nbytes) == 0
+    np.testing.assert_allclose(back, src)
+
+    # op registry listing includes the core names
+    n_ops = ctypes.c_uint32()
+    op_names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXTPUListAllOpNames(ctypes.byref(n_ops),
+                                   ctypes.byref(op_names)) == 0
+    all_ops = {op_names[i] for i in range(n_ops.value)}
+    assert {b"Convolution", b"FullyConnected", b"sum",
+            b"_contrib_FlashAttention"} <= all_ops
+
+    # error path: bad op name reports through MXTPUGetLastError
+    assert lib.MXTPUImperativeInvoke(b"no_such_op", 1, ins,
+                                     ctypes.byref(n_out), ctypes.byref(outs),
+                                     0, None, None) == -1
+    assert b"no_such_op" in lib.MXTPUGetLastError()
+    lib.MXTPUNDArrayFree(h)
