@@ -8,8 +8,12 @@
  *
  * Conventions: every function returns 0 on success, -1 on failure with
  * the message readable via MXTPUGetLastError() (thread-local).  Handles
- * are opaque.  Returned arrays (shapes, names, handle lists) are owned
- * by the library and valid until the next call on the same thread.
+ * are opaque.  Returned ARRAY STORAGE (shape buffers, name tables, the
+ * handle-list vector itself) is owned by the library and valid until the
+ * next call on the same thread — copy what you need.  Each individual
+ * NDArrayHandle returned by MXTPUNDArrayLoad / MXTPUImperativeInvoke is
+ * owned by the CALLER and must be released with MXTPUNDArrayFree, or the
+ * backing array stays alive for the process lifetime.
  *
  * dtype flags are the reference's mshadow enum: 0=float32 1=float64
  * 2=float16 3=uint8 4=int32 5=int8 6=int64.

@@ -200,4 +200,7 @@ def nd_invoke(op_name, in_hids, keys, vals):
     kwargs = dict(zip(keys, vals))
     res = _invoke(op_name, tuple(inputs), kwargs)
     outs = res if isinstance(res, (list, tuple)) else [res]
-    return [_nd_put(o) for o in outs if isinstance(o, NDArray)]
+    for o in outs:
+        if not isinstance(o, NDArray):  # _invoke's contract; keep loud
+            raise TypeError("op %s returned a non-NDArray output" % op_name)
+    return [_nd_put(o) for o in outs]

@@ -22,12 +22,14 @@ New-capability set beyond the reference (SURVEY.md §5.7, §7 step 8):
 """
 from .mesh import (MeshConfig, make_mesh, data_parallel_mesh, shard, replicate,
                    current_mesh, set_current_mesh)
-from .ring import ring_attention, ulysses_attention, local_attention
+from .ring import (ring_attention, ring_flash_attention,
+                   ulysses_attention, local_attention)
 from .pipeline import pipeline_spmd
 
 __all__ = [
     "MeshConfig", "make_mesh", "data_parallel_mesh", "shard", "replicate",
     "current_mesh", "set_current_mesh",
-    "ring_attention", "ulysses_attention", "local_attention",
+    "ring_attention", "ring_flash_attention", "ulysses_attention",
+    "local_attention",
     "pipeline_spmd",
 ]

@@ -4,6 +4,8 @@
 // mxnet_tpu/capi_shim.py (nd_* functions).
 #include "capi_common.h"
 
+#include "mxtpu/c_api.h"
+
 #include <cstdarg>
 #include <cstdint>
 #include <cstring>
@@ -13,9 +15,9 @@
 using mx_uint = uint32_t;
 using mxtpu_capi::GIL;
 using mxtpu_capi::ensure_python;
+using mxtpu_capi::call_shim;
 using mxtpu_capi::set_error;
 using mxtpu_capi::set_error_from_python;
-using mxtpu_capi::shim;
 
 namespace {
 
@@ -30,25 +32,6 @@ thread_local std::vector<mx_uint> t_shape;
 thread_local std::vector<std::string> t_names_store;
 thread_local std::vector<const char*> t_names;
 thread_local std::vector<void*> t_handles;
-
-PyObject* call_shim(const char* fn, const char* fmt, ...) {
-  PyObject* mod = shim();
-  if (!mod) {
-    set_error_from_python();
-    return nullptr;
-  }
-  va_list va;
-  va_start(va, fmt);
-  PyObject* callable = PyObject_GetAttrString(mod, fn);
-  PyObject* args = Py_VaBuildValue(fmt, va);
-  va_end(va);
-  PyObject* res = nullptr;
-  if (callable && args) res = PyObject_CallObject(callable, args);
-  Py_XDECREF(args);
-  Py_XDECREF(callable);
-  if (!res) set_error_from_python();
-  return res;
-}
 
 }  // namespace
 

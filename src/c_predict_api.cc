@@ -14,6 +14,8 @@
 
 #include "capi_common.h"
 
+#include "mxtpu/c_predict_api.h"
+
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -21,6 +23,7 @@
 
 using mx_uint = uint32_t;
 using mxtpu_capi::GIL;
+using mxtpu_capi::call_shim;
 using mxtpu_capi::ensure_python;
 using mxtpu_capi::set_error;
 using mxtpu_capi::set_error_from_python;
@@ -67,24 +70,16 @@ int MXTPUPredCreate(const char* symbol_json, const void* param_bytes,
   (void)dev_id;
   ensure_python();
   GIL gil;
-  PyObject* mod = shim();
-  if (!mod) {
-    set_error_from_python();
-    return -1;
-  }
   PyObject* keys = keys_to_py(num_input_nodes, input_keys);
   PyObject* shapes =
       shapes_to_py(num_input_nodes, input_shape_indptr, input_shape_data);
-  PyObject* res = PyObject_CallMethod(
-      mod, "create", "sy#OOi", symbol_json,
+  PyObject* res = call_shim(
+      "create", "(sy#OOi)", symbol_json,
       static_cast<const char*>(param_bytes),
       static_cast<Py_ssize_t>(param_size), keys, shapes, dev_type);
   Py_DECREF(keys);
   Py_DECREF(shapes);
-  if (!res) {
-    set_error_from_python();
-    return -1;
-  }
+  if (!res) return -1;
   auto* p = new Predictor();
   p->hid = PyLong_AsLongLong(res);
   Py_DECREF(res);
@@ -96,15 +91,12 @@ int MXTPUPredSetInput(void* handle, const char* key, const float* data,
                       mx_uint size) {
   auto* p = static_cast<Predictor*>(handle);
   GIL gil;
-  PyObject* res = PyObject_CallMethod(
-      shim(), "set_input", "Lsy#(k)", p->hid, key,
+  PyObject* res = call_shim(
+      "set_input", "(Lsy#(k))", p->hid, key,
       reinterpret_cast<const char*>(data),
       static_cast<Py_ssize_t>(size * sizeof(float)),
       static_cast<unsigned long>(size));
-  if (!res) {
-    set_error_from_python();
-    return -1;
-  }
+  if (!res) return -1;
   Py_DECREF(res);
   return 0;
 }
@@ -112,11 +104,8 @@ int MXTPUPredSetInput(void* handle, const char* key, const float* data,
 int MXTPUPredForward(void* handle) {
   auto* p = static_cast<Predictor*>(handle);
   GIL gil;
-  PyObject* res = PyObject_CallMethod(shim(), "forward", "L", p->hid);
-  if (!res) {
-    set_error_from_python();
-    return -1;
-  }
+  PyObject* res = call_shim("forward", "(L)", p->hid);
+  if (!res) return -1;
   Py_DECREF(res);
   return 0;
 }
@@ -125,13 +114,9 @@ int MXTPUPredGetOutputShape(void* handle, mx_uint index, mx_uint** shape_data,
                             mx_uint* shape_ndim) {
   auto* p = static_cast<Predictor*>(handle);
   GIL gil;
-  PyObject* res =
-      PyObject_CallMethod(shim(), "get_output_shape", "Lk", p->hid,
-                          static_cast<unsigned long>(index));
-  if (!res) {
-    set_error_from_python();
-    return -1;
-  }
+  PyObject* res = call_shim("get_output_shape", "(Lk)", p->hid,
+                            static_cast<unsigned long>(index));
+  if (!res) return -1;
   Py_ssize_t n = PyTuple_Size(res);
   p->last_shape.resize(n);
   for (Py_ssize_t i = 0; i < n; ++i) {
@@ -148,12 +133,9 @@ int MXTPUPredGetOutput(void* handle, mx_uint index, float* data,
                        mx_uint size) {
   auto* p = static_cast<Predictor*>(handle);
   GIL gil;
-  PyObject* res = PyObject_CallMethod(shim(), "get_output", "Lk", p->hid,
-                                      static_cast<unsigned long>(index));
-  if (!res) {
-    set_error_from_python();
-    return -1;
-  }
+  PyObject* res = call_shim("get_output", "(Lk)", p->hid,
+                            static_cast<unsigned long>(index));
+  if (!res) return -1;
   char* buf = nullptr;
   Py_ssize_t len = 0;
   if (PyBytes_AsStringAndSize(res, &buf, &len) != 0) {
@@ -181,14 +163,10 @@ int MXTPUPredReshape(mx_uint num_input_nodes, const char** input_keys,
   PyObject* keys = keys_to_py(num_input_nodes, input_keys);
   PyObject* shapes =
       shapes_to_py(num_input_nodes, input_shape_indptr, input_shape_data);
-  PyObject* res =
-      PyObject_CallMethod(shim(), "reshape", "LOO", p->hid, keys, shapes);
+  PyObject* res = call_shim("reshape", "(LOO)", p->hid, keys, shapes);
   Py_DECREF(keys);
   Py_DECREF(shapes);
-  if (!res) {
-    set_error_from_python();
-    return -1;
-  }
+  if (!res) return -1;
   auto* p2 = new Predictor();
   p2->hid = PyLong_AsLongLong(res);
   Py_DECREF(res);
@@ -201,7 +179,7 @@ int MXTPUPredFree(void* handle) {
   if (!p) return 0;
   {
     GIL gil;
-    PyObject* res = PyObject_CallMethod(shim(), "free", "L", p->hid);
+    PyObject* res = call_shim("free", "(L)", p->hid);
     if (res) Py_DECREF(res);
     else PyErr_Clear();
   }

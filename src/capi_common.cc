@@ -1,6 +1,7 @@
 // Shared C-ABI plumbing — see capi_common.h.
 #include "capi_common.h"
 
+#include <cstdarg>
 #include <mutex>
 
 namespace mxtpu_capi {
@@ -46,6 +47,25 @@ PyObject* shim() {
     mod = PyImport_ImportModule("mxnet_tpu.capi_shim");
   }
   return mod;
+}
+
+PyObject* call_shim(const char* fn, const char* fmt, ...) {
+  PyObject* mod = shim();
+  if (!mod) {
+    set_error_from_python();
+    return nullptr;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject* callable = PyObject_GetAttrString(mod, fn);
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  PyObject* res = nullptr;
+  if (callable && args) res = PyObject_CallObject(callable, args);
+  Py_XDECREF(args);
+  Py_XDECREF(callable);
+  if (!res) set_error_from_python();
+  return res;
 }
 
 }  // namespace mxtpu_capi

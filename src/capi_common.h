@@ -32,6 +32,11 @@ struct GIL {
 // The mxnet_tpu.capi_shim module (borrowed ref, cached; GIL held).
 PyObject* shim();
 
+// Call a capi_shim function with Py_BuildValue-style args (fmt must build
+// a tuple, e.g. "(Lsi)").  Returns a new ref, or nullptr with the error
+// already captured into g_last_error.  GIL must be held.
+PyObject* call_shim(const char* fn, const char* fmt, ...);
+
 }  // namespace mxtpu_capi
 
 #endif  // MXTPU_SRC_CAPI_COMMON_H_

@@ -183,11 +183,12 @@ def test_core_c_api_ndarray_and_invoke(tmp_path):
         b"sum", 1, ins, ctypes.byref(n_out), ctypes.byref(outs),
         1, keys, vals) == 0, lib.MXTPUGetLastError()
     assert n_out.value == 1
+    # the handle-list vector is only valid until the next call on this
+    # thread (header contract) — capture the handle value now
+    sum_h = ctypes.c_void_p(outs[0])
     out = np.zeros(2, np.float32)
-    # outs[0] is a bare int; re-wrap so ctypes passes a full 64-bit pointer
     assert lib.MXTPUNDArraySyncCopyToCPU(
-        ctypes.c_void_p(outs[0]), out.ctypes.data_as(ctypes.c_void_p),
-        out.nbytes) == 0
+        sum_h, out.ctypes.data_as(ctypes.c_void_p), out.nbytes) == 0
     np.testing.assert_allclose(out, src.sum(axis=1))
 
     # save named, load back, values survive
@@ -203,10 +204,10 @@ def test_core_c_api_ndarray_and_invoke(tmp_path):
                                 ctypes.byref(out_names)) == 0
     assert n_arr.value == 1 and n_names.value == 1
     assert out_names[0] == b"w"
+    loaded_h = ctypes.c_void_p(arrs[0])
     back = np.zeros((2, 3), np.float32)
     assert lib.MXTPUNDArraySyncCopyToCPU(
-        ctypes.c_void_p(arrs[0]), back.ctypes.data_as(ctypes.c_void_p),
-        back.nbytes) == 0
+        loaded_h, back.ctypes.data_as(ctypes.c_void_p), back.nbytes) == 0
     np.testing.assert_allclose(back, src)
 
     # op registry listing includes the core names
@@ -223,4 +224,7 @@ def test_core_c_api_ndarray_and_invoke(tmp_path):
                                      ctypes.byref(n_out), ctypes.byref(outs),
                                      0, None, None) == -1
     assert b"no_such_op" in lib.MXTPUGetLastError()
+    # per the header contract, invoke/load output handles are caller-owned
+    lib.MXTPUNDArrayFree(sum_h)
+    lib.MXTPUNDArrayFree(loaded_h)
     lib.MXTPUNDArrayFree(h)
