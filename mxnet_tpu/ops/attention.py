@@ -260,8 +260,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _flash_bwd_precompute(q, o, lse, do):
+    """Loop-invariant backward inputs: flattened q/dO layouts, the global
+    row lse, and delta_i = rowsum(dO ∘ O) (cheap elementwise+reduce, fused
+    by XLA). Split out so callers that sweep many K/V blocks against one Q
+    (the ring backward) compute these once, not per block. lse/delta get a
+    singleton middle dim so their (1, 1, bq) blocks pass the Mosaic
+    trailing-dims tiling rule (see _flash_forward)."""
+    import jax.numpy as jnp
+
+    b, sq, h, d = q.shape
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    dot = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    ot = o.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1)
+    return (qt, dot, lse.reshape(b * h, 1, sq),
+            delta.reshape(b * h, 1, sq))
+
+
 def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, pre=None):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -271,18 +290,11 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     bq = _pick_block(block_q, sq)
     bk = _pick_block(block_k, sk)
     nq, nk = sq // bq, sk // bk
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    if pre is None:
+        pre = _flash_bwd_precompute(q, o, lse, do)
+    qt, dot, lse3, delta3 = pre
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    dot = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    ot = o.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    # delta_i = rowsum(dO ∘ O): cheap elementwise+reduce, fused by XLA.
-    # lse/delta get a singleton middle dim so their (1, 1, bq) blocks pass
-    # the Mosaic trailing-dims tiling rule (see _flash_forward).
-    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
-                    axis=-1)
-    lse3 = lse.reshape(b * h, 1, sq)
-    delta3 = delta.reshape(b * h, 1, sq)
 
     from jax.experimental.pallas import tpu as pltpu
 

@@ -163,8 +163,10 @@ def _ring_flash_fwd(q, k, v, *, axis, vary_axes, n_shards, causal, scale,
                                   block_k, interpret)
 
         def blk_skip(_):
-            return (jnp.zeros((b, sq, h, d), q.dtype),
-                    jnp.full((b * h, sq), _NEG, jnp.float32))
+            # constants must carry the same varying-axis type as the other
+            # switch branches (check_vma on TPU rejects a mismatch)
+            return (_vary(jnp.zeros((b, sq, h, d), q.dtype)),
+                    _vary(jnp.full((b * h, sq), _NEG, jnp.float32)))
 
         if causal:
             branch = jnp.where(k_idx == idx, 0,
@@ -188,7 +190,7 @@ def _ring_flash_bwd(q, k, v, o, lse, do, *, axis, vary_axes, n_shards,
     import jax.numpy as jnp
     from jax import lax
 
-    from ..ops.attention import _flash_backward
+    from ..ops.attention import _flash_backward, _flash_bwd_precompute
 
     idx = lax.axis_index(axis)
     b, sq, h, d = q.shape
@@ -199,6 +201,9 @@ def _ring_flash_bwd(q, k, v, o, lse, do, *, axis, vary_axes, n_shards,
 
     dq0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32))
     dkv0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32))
+    # q/dO layouts, lse and delta do not change across ring steps —
+    # compute once, not per rotated block
+    pre = _flash_bwd_precompute(q, o, lse, do)
 
     def step(carry, t):
         dq, k_blk, v_blk, dk_blk, dv_blk = carry
@@ -206,14 +211,16 @@ def _ring_flash_bwd(q, k, v, o, lse, do, *, axis, vary_axes, n_shards,
 
         def go_diag(_):
             return _flash_backward(q, k_blk, v_blk, o, lse, do, True,
-                                   scale, block_q, block_k, interpret)
+                                   scale, block_q, block_k, interpret,
+                                   pre=pre)
 
         def go_full(_):
             return _flash_backward(q, k_blk, v_blk, o, lse, do, False,
-                                   scale, block_q, block_k, interpret)
+                                   scale, block_q, block_k, interpret,
+                                   pre=pre)
 
         def go_skip(_):
-            z = jnp.zeros((b, sq, h, d), q.dtype)
+            z = _vary(jnp.zeros((b, sq, h, d), q.dtype))
             return z, z, z
 
         if causal:

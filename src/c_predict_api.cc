@@ -179,7 +179,9 @@ int MXTPUPredFree(void* handle) {
   if (!p) return 0;
   {
     GIL gil;
-    PyObject* res = call_shim("free", "(L)", p->hid);
+    // deliberately NOT call_shim: a failed free is ignored and must not
+    // clobber the thread-local error a caller may be inspecting
+    PyObject* res = PyObject_CallMethod(shim(), "free", "L", p->hid);
     if (res) Py_DECREF(res);
     else PyErr_Clear();
   }

@@ -1,0 +1,41 @@
+"""Visualization parity (reference tests/python/unittest/test_viz.py:
+print_summary + plot_network over a small symbol)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             name="conv")
+    net = mx.sym.BatchNorm(net, name="bn")
+    net = mx.sym.Activation(net, act_type="relu", name="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max", name="pool")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(net), num_hidden=10,
+                                name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_print_summary(capsys):
+    mx.viz.print_summary(_net(), shape={"data": (1, 3, 16, 16)})
+    out = capsys.readouterr().out
+    # layer rows, shapes, and a parameter count must all be present
+    for token in ("conv", "fc", "Total params"):
+        assert token in out, out
+    # fc: (512 + 1) * 10 = 5130; bn: 8*2; conv counts only its bias filter
+    # term when fed by a bare data variable — the reference print_summary's
+    # own accounting quirk, kept for parity
+    assert "5130" in out
+    assert "Total params: 5154" in out
+    assert "8x8x8" in out  # pooled output shape column
+
+
+def test_plot_network_graphviz():
+    graphviz = pytest.importorskip("graphviz")
+    dot = mx.viz.plot_network(_net(), shape={"data": (1, 3, 16, 16)},
+                              save_format="dot")
+    src = dot.source if hasattr(dot, "source") else str(dot)
+    assert "conv" in src and "softmax" in src
