@@ -71,7 +71,8 @@ def make_step(mesh, heads, block, lr):
             d = q.shape[-1] // heads
             split = lambda t: t.reshape(b, s, heads, d)
             att = ring_flash_attention(split(q), split(k), split(v), mesh,
-                                       axis="seq", causal=True,
+                                       axis="seq", batch_axis="data",
+                                       causal=True,
                                        block_q=block, block_k=block)
             h = h + att.reshape(b, s, -1) @ lp["proj"]
             a = ln(h, lp["ln2"])
@@ -120,7 +121,12 @@ def main(argv=None):
 
     devs = jax.devices()
     n_seq = args.seq_shards
-    n_data = max(1, len(devs) // n_seq) if len(devs) >= n_seq else 1
+    if len(devs) < n_seq:
+        raise SystemExit(
+            "need %d devices for --seq-shards %d, found %d (set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=%d for a virtual mesh)"
+            % (n_seq, n_seq, len(devs), n_seq))
+    n_data = len(devs) // n_seq
     mesh = Mesh(np.array(devs[:n_data * n_seq]).reshape(n_data, n_seq),
                 ("data", "seq"))
     rng = np.random.RandomState(0)
