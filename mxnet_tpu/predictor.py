@@ -79,6 +79,7 @@ class Predictor:
         aux_names = symbol.list_auxiliary_states()
 
         args = {}
+        self._synthesized = set()
         for name, shape in zip(arg_names, arg_shapes):
             if name in self._input_shapes:
                 args[name] = nd.zeros(shape, self._ctx, dtype=self._dtype)
@@ -92,7 +93,12 @@ class Predictor:
                 args[name] = p if isinstance(p, nd.NDArray) else \
                     nd.array(p, self._ctx)
             else:
-                raise MXNetError("missing parameter %r" % name)
+                # reference MXPredCreate allocates missing args without
+                # initializing them (c_predict_api.cc:190-195); we
+                # zero-fill for determinism — loss labels in a saved
+                # training symbol bind as zeros at inference
+                args[name] = nd.zeros(shape, self._ctx, dtype=self._dtype)
+                self._synthesized.add(name)
         aux = {}
         for name, shape in zip(aux_names, aux_shapes):
             if name not in aux_params:
@@ -104,6 +110,16 @@ class Predictor:
         self._exec = symbol.bind(self._ctx, args, args_grad=None,
                                  grad_req="null", aux_states=aux)
         self._input_names = list(self._input_shapes)
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, input_shapes, ctx=None,
+                        dtype=np.float32):
+        """Build a predictor straight from ``save_checkpoint`` files
+        (``prefix-symbol.json`` + ``prefix-%04d.params`` — the file pair
+        MXPredCreate consumes in the reference)."""
+        return cls("%s-symbol.json" % prefix,
+                   "%s-%04d.params" % (prefix, epoch),
+                   input_shapes, ctx=ctx, dtype=dtype)
 
     # -- MXPredSetInput / MXPredForward / MXPredGetOutput parity ----------
     def set_input(self, name, value):
@@ -127,8 +143,11 @@ class Predictor:
         """Re-bind for new static input shapes (MXPredReshape,
         c_predict_api.cc:150-210).  Inputs not named keep their current
         shapes, matching the reference."""
+        # synthesized (zero-filled) args are per-shape scratch, not model
+        # params: drop them so the new bind re-synthesizes at its shapes
         params = {("arg:%s" % k): v for k, v in self._exec.arg_dict.items()
-                  if k not in self._input_shapes}
+                  if k not in self._input_shapes
+                  and k not in self._synthesized}
         params.update({("aux:%s" % k): v
                        for k, v in self._exec.aux_dict.items()})
         merged = dict(self._input_shapes)

@@ -71,3 +71,48 @@ def test_exported_artifact_roundtrip(tmp_path):
     got = np.asarray(served.forward(
         data=batch, softmax_label=np.zeros(10, np.float32))[0])
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_from_checkpoint_zero_fills_labels(tmp_path):
+    """from_checkpoint consumes save_checkpoint's file pair directly; the
+    training symbol's loss label binds as zeros at inference (reference
+    MXPredCreate allocates missing args zero-filled)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax")
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=2, optimizer="sgd")
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 2)
+
+    pred = mx.Predictor.from_checkpoint(prefix, 2, {"data": (8, 6)})
+    out = pred.forward(data=X[:8])[0].asnumpy()
+    it.reset()
+    mod.forward(next(iter(it)), is_train=False)
+    np.testing.assert_allclose(out, mod.get_outputs()[0].asnumpy(),
+                               rtol=1e-5)
+
+
+def test_predictor_reshape_after_from_checkpoint(tmp_path):
+    """reshape() on a checkpoint whose symbol carries a loss label: the
+    zero-filled label must be re-synthesized at the new batch size."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(16, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax")
+    mod = mx.mod.Module(net)
+    mod.fit(mx.io.NDArrayIter(X, y, batch_size=8), num_epoch=1,
+            optimizer="sgd")
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+    pred = mx.Predictor.from_checkpoint(prefix, 1, {"data": (8, 5)})
+    small = pred.reshape({"data": (2, 5)})
+    a = small.forward(data=X[:2])[0].asnumpy()
+    b = pred.forward(data=X[:8])[0].asnumpy()[:2]
+    np.testing.assert_allclose(a, b, rtol=1e-5)

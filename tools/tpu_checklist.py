@@ -1,0 +1,118 @@
+"""One-shot TPU hardware validation: runs every chip-dependent check and
+prints one JSON line per item (all also run standalone; this exists so a
+recovered/fresh chip can be fully validated in one command).
+
+  1. flash-attention fwd+bwd vs dense oracle (bf16, causal + full)
+  2. flash kernel train-step throughput at 8k (the PERF.md ladder)
+  3. 16k-token causal train step (the long-sequence claim)
+  4. ring_flash_attention causal on a 1-device mesh (traces all switch
+     branches under the TPU vma checker)
+  5. bench.py headline (ResNet-50 Module path) unless --skip-resnet
+
+Usage: python tools/tpu_checklist.py [--skip-resnet]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def report(name, **kw):
+    print(json.dumps({"check": name, **kw}), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-resnet", action="store_true")
+    ap.add_argument("--seq", type=int, default=8192)
+    cli = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    assert jax.default_backend() == "tpu", \
+        "tpu_checklist needs the TPU backend (got %s)" % jax.default_backend()
+
+    from mxnet_tpu.ops.attention import flash_attention
+    from mxnet_tpu.parallel.ring import local_attention
+
+    # 1. kernel correctness vs dense oracle
+    b, s, h, d = 2, 1024, 4, 128
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, s, h, d),
+                                 jnp.bfloat16) * 0.2 for i in range(3))
+    for causal in (False, True):
+        o = flash_attention(q, k, v, causal=causal)
+        ref = local_attention(q, k, v, causal=causal)
+        err = float(jnp.max(jnp.abs(o.astype(jnp.float32)
+                                    - ref.astype(jnp.float32))))
+        g = jax.grad(lambda q, k, v: jnp.mean(flash_attention(
+            q, k, v, causal=causal).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.mean(local_attention(
+            q, k, v, causal=causal).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gerr = max(float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                         - b_.astype(jnp.float32))))
+                   for a, b_ in zip(g, gr))
+        report("flash_vs_oracle", causal=causal, fwd_maxerr=round(err, 5),
+               bwd_maxerr=round(gerr, 5), ok=err < 0.02 and gerr < 0.02)
+
+    # 2. throughput ladder at --seq
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "bench_attention.py"),
+         "--seq", str(cli.seq), "--steps", "10"],
+        capture_output=True, text=True, timeout=1200)
+    line = res.stdout.strip().splitlines()[-1] if res.stdout.strip() else ""
+    report("flash_train_bench", result=json.loads(line) if line else None,
+           ok=res.returncode == 0)
+
+    # 3. 16k-token causal train step on one chip
+    s16 = 16384
+    q16 = jax.random.normal(jax.random.PRNGKey(0), (1, s16, 8, 128),
+                            jnp.bfloat16) * 0.1
+    step = jax.jit(jax.grad(lambda q: jnp.mean(flash_attention(
+        q, q, q, causal=True).astype(jnp.float32) ** 2)))
+    t0 = time.time()
+    g16 = step(q16)
+    jax.block_until_ready(g16)
+    report("flash_16k_train_step", first_step_s=round(time.time() - t0, 1),
+           finite=bool(jnp.isfinite(g16.astype(jnp.float32)).all()), ok=True)
+
+    # 4. ring-flash causal traces under the TPU vma checker (all lax.switch
+    # branches are traced even on a 1-device mesh)
+    from jax.sharding import Mesh
+    from mxnet_tpu.parallel.ring import ring_flash_attention
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("seq",))
+    qr = jax.random.normal(jax.random.PRNGKey(1), (1, 512, 2, 128),
+                           jnp.bfloat16) * 0.2
+    out = ring_flash_attention(qr, qr, qr, mesh, axis="seq", causal=True,
+                               block_q=128, block_k=128)
+    refr = local_attention(qr, qr, qr, causal=True)
+    rerr = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                 - refr.astype(jnp.float32))))
+    gring = jax.grad(lambda q: jnp.mean(ring_flash_attention(
+        q, q, q, mesh, axis="seq", causal=True, block_q=128,
+        block_k=128).astype(jnp.float32) ** 2))(qr)
+    jax.block_until_ready(gring)
+    report("ring_flash_tpu_vma", fwd_maxerr=round(rerr, 5), ok=rerr < 0.02)
+
+    # 5. headline bench
+    if not cli.skip_resnet:
+        res = subprocess.run([sys.executable,
+                              os.path.join(ROOT, "bench.py")],
+                             capture_output=True, text=True, timeout=3000)
+        line = res.stdout.strip().splitlines()[-1] if res.stdout.strip() \
+            else ""
+        report("resnet50_bench", result=json.loads(line) if line else None,
+               ok=res.returncode == 0)
+
+
+if __name__ == "__main__":
+    main()
