@@ -84,4 +84,12 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # emit the one JSON line even on failure
+        print(json.dumps({"metric": "resnet50_train_throughput",
+                          "value": 0.0, "unit": "img/s",
+                          "vs_baseline": 0.0,
+                          "error": "%s: %s" % (type(e).__name__,
+                                               str(e)[:300])}))
+        sys.exit(1)

@@ -659,12 +659,22 @@ _GRAPH_LEVEL_ATTRS = frozenset({
     "ctx_group", "lr_mult", "wd_mult", "force_mirroring", "mirror_stage"})
 
 
+# per-parameter multiplier keys the pre-NNVM format hid in op attrs
+# (reference kHiddenKeys, c_api_symbolic.cc:20-22)
+_LEGACY_HIDDEN_KEYS = ("ctx_group", "lr_mult", "wd_mult",
+                       "force_mirroring", "mirror_stage")
+
+
 def load_json(json_str: str) -> Symbol:
     data = json.loads(json_str)
     jnodes = data["nodes"]
     nodes: List[_Node] = []
     for jn in jnodes:
-        attr = jn.get("attr", jn.get("attrs", {})) or {}
+        attr = dict(jn.get("attr", jn.get("attrs", {})) or {})
+        # legacy (pre-NNVM) graphs keep op params in a separate "param"
+        # dict of strings — fold them in, the upgrade pass the reference
+        # runs in src/nnvm/legacy_json_util.cc
+        attr.update(jn.get("param", {}) or {})
         if jn["op"] == "null":
             nodes.append(_Node(None, jn["name"], {}, [], attr))
         else:
@@ -687,6 +697,21 @@ def load_json(json_str: str) -> Symbol:
             graph_attrs = {k: v for k, v in attr.items() if not _is_param(k)}
             parsed = op.parse_attrs(param_attrs)
             inputs = [(nodes[i[0]], i[1]) for i in jn["inputs"]]
+            if "param" in jn:
+                # legacy upgrade, part 2 (legacy_json_util.cc:60-84):
+                # "{input}_{key}" attrs (e.g. weight_lr_mult) push down onto
+                # the named variable input as "__{key}__"
+                in_names = op.input_names(parsed)
+                for k in list(graph_attrs):
+                    for hk in _LEGACY_HIDDEN_KEYS:
+                        if k.endswith("_" + hk) and len(k) > len(hk) + 1:
+                            prefix = k[: -len(hk) - 1]
+                            if prefix in in_names:
+                                tgt = inputs[in_names.index(prefix)][0]
+                                if tgt.op is None:
+                                    tgt.attr_dict["__%s__" % hk] = \
+                                        graph_attrs.pop(k)
+                            break
             nodes.append(_Node(op, jn["name"], parsed, inputs, graph_attrs))
     heads = [(nodes[h[0]], h[1] if len(h) > 1 else 0) for h in data["heads"]]
     return Symbol(heads)
