@@ -64,3 +64,4 @@ from .ops import register_pallas_op, Param
 from . import rtc
 from . import torch as th
 from . import checkpoint
+from . import notebook

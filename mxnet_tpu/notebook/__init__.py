@@ -1,0 +1,5 @@
+"""Notebook helpers (reference python/mxnet/notebook/: live
+training-curve plotting). See callback.py."""
+from . import callback  # noqa: F401
+
+__all__ = ["callback"]

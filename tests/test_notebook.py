@@ -1,0 +1,43 @@
+"""Notebook training-curve callbacks (reference
+python/mxnet/notebook/callback.py capability: metric collection,
+export, live curve — headless-friendly here)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.notebook.callback import LiveLearningCurve, MetricsLogger
+
+
+def test_metrics_logger_collects_through_fit(tmp_path, capsys):
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 10).astype(np.float32)
+    w = rng.randn(10, 2).astype(np.float32)
+    y = (X @ w).argmax(axis=1).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=2), name="softmax")
+    logger = MetricsLogger(frequent=1)
+    live = LiveLearningCurve(metric_name="accuracy", frequent=1)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    val = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(net)
+    mod.fit(it, eval_data=val, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            batch_end_callback=[logger.train_cb, live.train_cb],
+            eval_end_callback=logger.eval_cb)
+    accs = logger.values("accuracy")
+    assert len(accs) >= 3
+    assert accs[-1] > accs[0] or accs[-1] > 0.9  # learning visible
+    assert logger.values("accuracy", "eval")  # eval phase collected too
+    # sparkline renders one glyph per point (capped at width)
+    line = logger.sparkline("accuracy", width=10)
+    assert 0 < len(line) <= 10
+    assert "accuracy" in capsys.readouterr().out  # live curve printed
+    # csv export round-trips
+    path = tmp_path / "curves.csv"
+    logger.to_csv(str(path))
+    rows = path.read_text().strip().splitlines()
+    assert rows[0].startswith("phase,metric")
+    assert any(r.startswith("train,accuracy") for r in rows[1:])
+    assert any(r.startswith("eval,accuracy") for r in rows[1:])
+    # a nan sample (metric before any update) must not break rendering
+    logger._append(logger.train, "accuracy", float("nan"), 99, 0)
+    assert len(logger.sparkline("accuracy")) > 0
