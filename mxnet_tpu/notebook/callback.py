@@ -68,7 +68,8 @@ class MetricsLogger:
             return ""
         if len(vals) > width:
             stride = len(vals) / float(width)
-            vals = [vals[int(i * stride)] for i in range(width)]
+            vals = [vals[min(len(vals) - 1, int(i * stride))]
+                    for i in range(width - 1)] + [vals[-1]]
         lo, hi = min(vals), max(vals)
         span = (hi - lo) or 1.0
         return "".join(

@@ -14,22 +14,27 @@ New-capability set beyond the reference (SURVEY.md §5.7, §7 step 8):
 
 * ``ring_attention`` — exact blockwise attention with keys/values rotating
   around the mesh ring (ppermute), sequence-parallel long-context training.
+* ``ring_flash_attention`` — the same ring with the Pallas flash kernel as
+  the per-block compute, fwd and bwd (dk/dv ride the ring home).
 * ``ulysses_attention`` — all-to-all sequence parallelism (shard heads during
   attention, sequence elsewhere).
 * ``pipeline_spmd`` — collective-permute pipeline over stacked homogeneous
   stages (the TPU-native form of the reference's model-parallel LSTM
   placement, example/model-parallel-lstm/lstm.py:142-205).
+* ``moe_ffn`` — expert parallelism: mixture-of-experts FFN with experts
+  sharded over a mesh axis, exact einsum dispatch, psum combine.
 """
 from .mesh import (MeshConfig, make_mesh, data_parallel_mesh, shard, replicate,
                    current_mesh, set_current_mesh)
 from .ring import (ring_attention, ring_flash_attention,
                    ulysses_attention, local_attention)
+from .moe import moe_ffn, moe_ffn_reference
 from .pipeline import pipeline_spmd
 
 __all__ = [
     "MeshConfig", "make_mesh", "data_parallel_mesh", "shard", "replicate",
     "current_mesh", "set_current_mesh",
     "ring_attention", "ring_flash_attention", "ulysses_attention",
-    "local_attention",
+    "local_attention", "moe_ffn", "moe_ffn_reference",
     "pipeline_spmd",
 ]
