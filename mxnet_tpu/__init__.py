@@ -65,3 +65,7 @@ from . import rtc
 from . import torch as th
 from . import checkpoint
 from . import notebook
+from . import log
+from . import misc
+from . import libinfo
+from . import executor_manager

@@ -1,0 +1,18 @@
+"""Internal-op namespace (reference python/mxnet/_ndarray_internal.py:
+the codegen target module holding the ``_``-prefixed imperative ops).
+Here every registered op — public and internal — is generated straight
+into ``mxnet_tpu.ndarray``; this module re-exports the underscore subset
+under the reference's import path for code that does
+``from mxnet._ndarray_internal import _plus_scalar``-style imports."""
+from . import ndarray as _nd
+
+
+def __getattr__(name):
+    if name.startswith("_") and hasattr(_nd, name):
+        return getattr(_nd, name)
+    raise AttributeError("no internal NDArray op %r" % name)
+
+
+def __dir__():
+    return [n for n in dir(_nd) if n.startswith("_") and
+            not n.startswith("__")]

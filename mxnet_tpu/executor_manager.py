@@ -1,0 +1,148 @@
+"""Legacy multi-device executor manager (reference
+python/mxnet/executor_manager.py: `_split_input_slice` workload split +
+`DataParallelExecutorManager`, the pre-Module training plumbing that
+FeedForward used).
+
+Here the manager is a thin legacy-API adapter over the mesh-native
+``module.executor_group.DataParallelExecutorGroup`` — one executor over a
+device mesh instead of one executor per device.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+import numpy as np
+
+from .base import MXNetError
+
+
+def _split_input_slice(batch_size: int, work_load_list: List[float]):
+    """Split ``batch_size`` into per-device slices proportional to
+    ``work_load_list`` (reference executor_manager.py:15-50)."""
+    total = sum(work_load_list)
+    if total <= 0:
+        raise ValueError("Invalid workload")
+    slices = []
+    start = 0
+    for i, load in enumerate(work_load_list):
+        if i == len(work_load_list) - 1:
+            stop = batch_size
+        else:
+            stop = min(int(round(start + batch_size * load / total)),
+                       batch_size)
+        if stop <= start:
+            raise ValueError(
+                "Too many slices. Some splits are empty (batch %d over %d "
+                "workers)" % (batch_size, len(work_load_list)))
+        slices.append(slice(start, stop))
+        start = stop
+    return slices
+
+
+def _check_arguments(symbol):
+    """Reject duplicate argument/aux names (reference
+    executor_manager.py:52-80; the bind-time duplicate-var check)."""
+    arg_names = symbol.list_arguments()
+    if len(set(arg_names)) != len(arg_names):
+        dup = [n for n in set(arg_names) if arg_names.count(n) > 1]
+        raise ValueError(
+            "Find duplicated argument name, please make the weight name "
+            "non-duplicated, duplicates: %s" % dup)
+    aux_names = symbol.list_auxiliary_states()
+    if len(set(aux_names)) != len(aux_names):
+        raise ValueError("Find duplicated auxiliary param name")
+
+
+def _load_general(data, targets):
+    """Copy a list of NDArray/ndarray into a list of target NDArrays."""
+    for d_src, d_target in zip(data, targets):
+        d_target[:] = d_src
+
+
+def _load_data(batch, targets):
+    _load_general(batch.data, targets)
+
+
+def _load_label(batch, targets):
+    _load_general(batch.label, targets)
+
+
+class DataParallelExecutorManager:
+    """Legacy training-loop helper: bind once over the contexts, then
+    ``load_data_batch`` / ``forward`` / ``backward`` / ``update_metric``
+    (reference executor_manager.py DataParallelExecutorManager)."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names=None,
+                 param_names=None, aux_names=None, work_load_list=None,
+                 logger=None, sym_gen=None):
+        if logger is None:
+            logger = logging
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, (list, tuple)) else [ctx]
+        if work_load_list is None:
+            work_load_list = [1] * len(self._ctx)
+        if len(work_load_list) != len(self._ctx):
+            raise MXNetError("Invalid settings for work load.")
+        _check_arguments(symbol)
+        self._arg_names = arg_names or symbol.list_arguments()
+        self._aux_names = aux_names or symbol.list_auxiliary_states()
+        data_names = [d[0] for d in train_data.provide_data]
+        label_names = [l[0] for l in (train_data.provide_label or [])]
+        self._param_names = param_names or [
+            n for n in self._arg_names
+            if n not in data_names and n not in label_names]
+        from .module.executor_group import DataParallelExecutorGroup
+
+        self._group = DataParallelExecutorGroup(
+            symbol, self._ctx, work_load_list,
+            train_data.provide_data, train_data.provide_label,
+            self._param_names, for_training=True, inputs_need_grad=False)
+        self._group.bind_exec(train_data.provide_data,
+                              train_data.provide_label)
+        self._batch = None
+        self.slices = _split_input_slice(
+            train_data.batch_size
+            if hasattr(train_data, "batch_size")
+            else train_data.provide_data[0][1][0], work_load_list)
+
+    # -- params -----------------------------------------------------------
+    def set_params(self, arg_params, aux_params):
+        self._group.set_params(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        self._group.get_params(arg_params, aux_params)
+
+    @property
+    def param_names(self):
+        return self._param_names
+
+    @property
+    def param_arrays(self):
+        return self._group.param_arrays
+
+    @property
+    def grad_arrays(self):
+        return self._group.grad_arrays
+
+    @property
+    def aux_arrays(self):
+        return self._group.aux_arrays
+
+    # -- the step ---------------------------------------------------------
+    def install_monitor(self, monitor):
+        self._group.install_monitor(monitor)
+
+    def load_data_batch(self, data_batch):
+        self._batch = data_batch
+
+    def forward(self, is_train=False):
+        if self._batch is None:
+            raise MXNetError("call load_data_batch before forward")
+        self._group.forward(self._batch, is_train=is_train)
+
+    def backward(self):
+        self._group.backward()
+
+    def update_metric(self, metric, labels):
+        self._group.update_metric(metric, labels)

@@ -1,0 +1,18 @@
+"""Library-location helper (reference python/mxnet/libinfo.py
+find_lib_path). The compute path here is JAX/XLA (no libmxnet.so); the
+native runtime pieces are ``libmxtpu.so`` (RecordIO/decode) and
+``libmxtpu_capi.so`` (the C ABI), both living next to the package."""
+from __future__ import annotations
+
+import os
+
+__version__ = "0.9.5-tpu"
+
+
+def find_lib_path():
+    """Paths of the native libraries that exist on disk (build with
+    ``make -C src all``); empty list when none are built yet."""
+    pkg_dir = os.path.dirname(os.path.abspath(os.path.expanduser(__file__)))
+    candidates = [os.path.join(pkg_dir, name)
+                  for name in ("libmxtpu.so", "libmxtpu_capi.so")]
+    return [p for p in candidates if os.path.exists(p)]

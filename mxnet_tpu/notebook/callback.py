@@ -67,9 +67,8 @@ class MetricsLogger:
         if not vals:
             return ""
         if len(vals) > width:
-            stride = len(vals) / float(width)
-            vals = [vals[min(len(vals) - 1, int(i * stride))]
-                    for i in range(width - 1)] + [vals[-1]]
+            stride = (len(vals) - 1) / float(width - 1)
+            vals = [vals[round(i * stride)] for i in range(width)]
         lo, hi = min(vals), max(vals)
         span = (hi - lo) or 1.0
         return "".join(

@@ -14,9 +14,22 @@ function when token counts outgrow replication.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
-import numpy as np
+
+def _gate_combine(x, gate_w, top_k):
+    """combine[b, s, E]: renormalized top-k gate weight of each expert for
+    each token — the single routing implementation shared by the sharded
+    path and the dense oracle."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    logits = jnp.einsum("bsd,de->bse", x, gate_w)
+    weights, assign = lax.top_k(jax.nn.softmax(logits, axis=-1), top_k)
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return jnp.sum(
+        jax.nn.one_hot(assign, gate_w.shape[-1], dtype=x.dtype)
+        * weights[..., None], axis=2)
 
 
 def moe_ffn_reference(x, gate_w, w1, w2, top_k=1, act=None):
@@ -26,14 +39,7 @@ def moe_ffn_reference(x, gate_w, w1, w2, top_k=1, act=None):
     import jax.numpy as jnp
 
     act = act or jax.nn.gelu
-    logits = jnp.einsum("bsd,de->bse", x, gate_w)
-    weights, assign = jax.lax.top_k(jax.nn.softmax(logits, axis=-1), top_k)
-    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
-    e = gate_w.shape[-1]
-    # combine[b, s, E]: renormalized weight of each expert for each token
-    combine = jnp.sum(
-        jax.nn.one_hot(assign, e, dtype=x.dtype) * weights[..., None],
-        axis=2)
+    combine = _gate_combine(x, gate_w, top_k)
     hidden = act(jnp.einsum("bsd,edh->besh", x, w1))
     out = jnp.einsum("besh,ehd->besd", hidden, w2)
     return jnp.einsum("bse,besd->bsd", combine, out)
@@ -44,17 +50,11 @@ def _moe_inner(x, gate_w, w1, w2, *, axis, top_k, act):
     import jax.numpy as jnp
     from jax import lax
 
-    e_total = gate_w.shape[-1]
     e_local = w1.shape[0]
     idx = lax.axis_index(axis)
     # routing is computed from the replicated gate everywhere (identical
     # on all shards; avoids a broadcast)
-    logits = jnp.einsum("bsd,de->bse", x, gate_w)
-    weights, assign = lax.top_k(jax.nn.softmax(logits, axis=-1), top_k)
-    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
-    combine = jnp.sum(
-        jax.nn.one_hot(assign, e_total, dtype=x.dtype)
-        * weights[..., None], axis=2)                     # [b, s, E]
+    combine = _gate_combine(x, gate_w, top_k)             # [b, s, E]
     local = lax.dynamic_slice_in_dim(combine, idx * e_local, e_local,
                                      axis=2)              # [b, s, E/n]
     hidden = act(jnp.einsum("bsd,edh->besh", x, w1))
@@ -74,11 +74,14 @@ def moe_ffn(x, gate_w, w1, w2, mesh, axis: str = "expert", top_k: int = 1,
 
     act = act or jax.nn.gelu
     n = mesh.shape[axis]
-    if w1.shape[0] % n or gate_w.shape[-1] != w1.shape[0]:
+    if gate_w.shape[-1] != w1.shape[0]:
         raise ValueError(
-            "experts (%d) must be divisible by mesh axis %r size %d and "
-            "match the gate (%d)"
-            % (w1.shape[0], axis, n, gate_w.shape[-1]))
+            "gate has %d experts but w1 has %d"
+            % (gate_w.shape[-1], w1.shape[0]))
+    if w1.shape[0] % n:
+        raise ValueError(
+            "experts (%d) must be divisible by mesh axis %r size %d"
+            % (w1.shape[0], axis, n))
     inner = functools.partial(_moe_inner, axis=axis, top_k=top_k, act=act)
     fn = shard_map(
         inner, mesh=mesh,

@@ -1,0 +1,115 @@
+"""Legacy/utility module parity: executor_manager, log, misc, libinfo,
+_ndarray_internal/_symbol_internal, ndarray_doc/symbol_doc (reference
+python/mxnet counterparts)."""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.executor_manager import (DataParallelExecutorManager,
+                                        _check_arguments,
+                                        _split_input_slice)
+
+
+def test_split_input_slice():
+    assert _split_input_slice(10, [1, 1]) == [slice(0, 5), slice(5, 10)]
+    s = _split_input_slice(9, [1, 2])
+    assert s[0].stop == s[1].start and s[-1].stop == 9
+    with pytest.raises(ValueError):
+        _split_input_slice(2, [1, 1, 1])  # an empty split
+
+
+def test_check_arguments_duplicates():
+    # two DISTINCT variables with the same name (one shared node would be
+    # legitimately deduplicated)
+    net = mx.sym.elemwise_add(
+        mx.sym.FullyConnected(mx.sym.Variable("data"),
+                              weight=mx.sym.Variable("w"),
+                              num_hidden=4, no_bias=True, name="fc1"),
+        mx.sym.FullyConnected(mx.sym.Variable("data2"),
+                              weight=mx.sym.Variable("w"),
+                              num_hidden=4, no_bias=True, name="fc2"))
+    with pytest.raises(ValueError, match="duplicated"):
+        _check_arguments(net)
+
+
+def test_executor_manager_step():
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+    man = DataParallelExecutorManager(net, [mx.cpu(0), mx.cpu(1)], it)
+    man.set_params({"fc_weight": mx.nd.array(
+        rng.randn(2, 6).astype("f") * 0.2), "fc_bias": mx.nd.zeros((2,))},
+        {})
+    batch = it.next()
+    man.load_data_batch(batch)
+    man.forward(is_train=True)
+    man.backward()
+    metric = mx.metric.Accuracy()
+    man.update_metric(metric, batch.label)
+    assert 0.0 <= metric.get()[1] <= 1.0
+    assert man.param_names == ["fc_weight", "fc_bias"]
+    assert len(man.grad_arrays) == 2
+    out_params = {"fc_weight": mx.nd.zeros((2, 6)),
+                  "fc_bias": mx.nd.zeros((2,))}
+    man.copy_to(out_params, {})
+    assert float(np.abs(out_params["fc_weight"].asnumpy()).sum()) > 0
+
+
+def test_log_module(tmp_path):
+    logger = mx.log.get_logger("t_parity", level=mx.log.DEBUG)
+    assert logger.level == logging.DEBUG
+    f = tmp_path / "x.log"
+    flog = mx.log.get_logger("t_file", filename=str(f), level=mx.log.INFO)
+    flog.info("hello-parity")
+    for h in flog.handlers:
+        h.flush()
+    assert "hello-parity" in f.read_text()
+
+
+def test_misc_factor_scheduler():
+    from mxnet_tpu.misc import FactorScheduler
+
+    sched = FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(0) == 1.0
+    assert sched(10) == 0.5
+    assert sched(25) == 0.25
+    with pytest.raises(ValueError):
+        FactorScheduler(step=0)
+
+
+def test_libinfo_paths():
+    from mxnet_tpu import libinfo
+
+    paths = libinfo.find_lib_path()
+    assert all(p.endswith(".so") for p in paths)
+
+
+def test_internal_namespaces():
+    from mxnet_tpu import _ndarray_internal, _symbol_internal
+
+    out = _ndarray_internal._plus_scalar(mx.nd.ones((2,)), scalar=3.0)
+    np.testing.assert_allclose(out.asnumpy(), [4, 4])
+    s = _symbol_internal._plus_scalar(mx.sym.Variable("x"), scalar=1.0)
+    assert s.list_arguments() == ["x"]
+    with pytest.raises(AttributeError):
+        _ndarray_internal._no_such_op_xyz
+
+
+def test_doc_registries():
+    from mxnet_tpu import ndarray_doc, symbol_doc
+
+    class FullyConnected(ndarray_doc.NDArrayDoc):
+        """Extra FC doc."""
+
+    assert ndarray_doc.get_extra_doc("FullyConnected") == "Extra FC doc."
+    shapes = symbol_doc.SymbolDoc.get_output_shape(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3),
+        data=(2, 5))
+    assert list(shapes.values())[0] == (2, 3)

@@ -184,3 +184,6 @@ def test_moe_validates_expert_divisibility():
     with pytest.raises(ValueError, match="divisible"):
         parallel.moe_ffn(x, jnp.zeros((4, 6)), jnp.zeros((6, 4, 8)),
                          jnp.zeros((6, 8, 4)), mesh)
+    with pytest.raises(ValueError, match="gate has"):
+        parallel.moe_ffn(x, jnp.zeros((4, 8)), jnp.zeros((4, 4, 8)),
+                         jnp.zeros((4, 8, 4)), mesh)
