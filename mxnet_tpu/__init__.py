@@ -68,4 +68,5 @@ from . import notebook
 from . import log
 from . import misc
 from . import libinfo
+from .libinfo import __version__
 from . import executor_manager

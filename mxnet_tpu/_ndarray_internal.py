@@ -8,7 +8,8 @@ from . import ndarray as _nd
 
 
 def __getattr__(name):
-    if name.startswith("_") and hasattr(_nd, name):
+    if name.startswith("_") and not name.startswith("__") \
+            and hasattr(_nd, name):
         return getattr(_nd, name)
     raise AttributeError("no internal NDArray op %r" % name)
 

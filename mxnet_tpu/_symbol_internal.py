@@ -4,7 +4,8 @@ from . import symbol as _sym
 
 
 def __getattr__(name):
-    if name.startswith("_") and hasattr(_sym, name):
+    if name.startswith("_") and not name.startswith("__") \
+            and hasattr(_sym, name):
         return getattr(_sym, name)
     raise AttributeError("no internal Symbol op %r" % name)
 

@@ -78,6 +78,10 @@ class DataParallelExecutorManager:
                  logger=None, sym_gen=None):
         if logger is None:
             logger = logging
+        if sym_gen is not None:
+            raise NotImplementedError(
+                "sym_gen (per-bucket symbols) is not supported by this "
+                "adapter; use mx.mod.BucketingModule for bucketed training")
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, (list, tuple)) else [ctx]
         if work_load_list is None:

@@ -66,7 +66,9 @@ class MetricsLogger:
         vals = [v for v in self.values(name, which) if math.isfinite(v)]
         if not vals:
             return ""
-        if len(vals) > width:
+        if width <= 1:
+            vals = vals[-1:]
+        elif len(vals) > width:
             stride = (len(vals) - 1) / float(width - 1)
             vals = [vals[round(i * stride)] for i in range(width)]
         lo, hi = min(vals), max(vals)

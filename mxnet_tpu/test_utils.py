@@ -22,7 +22,7 @@ __all__ = [
     "assert_almost_equal", "rand_shape_nd", "rand_ndarray",
     "numeric_grad", "check_numeric_gradient", "check_symbolic_forward",
     "check_symbolic_backward", "check_consistency", "simple_forward",
-    "DummyIter",
+    "check_speed", "DummyIter",
 ]
 
 _RTOL = 1e-5
@@ -220,6 +220,53 @@ def simple_forward(sym: Symbol, ctx=None, **inputs):
     exe = _bind_with(sym, inputs, grad_req="null", ctx=ctx)
     outs = exe.forward(is_train=False)
     return outs[0] if len(outs) == 1 else outs
+
+
+def check_speed(sym: Symbol, location=None, ctx=None, N=20,
+                grad_req="write", typ="whole", **kwargs):
+    """Average seconds per run of a symbol (reference test_utils
+    check_speed): ``typ="whole"`` times forward_backward, ``"forward"``
+    forward only. ``location`` maps args to arrays; when absent, shapes
+    come from ``kwargs`` and inputs are random normal. The first run is
+    excluded (compile)."""
+    import time
+
+    from . import ndarray as nd
+    from .context import cpu as _cpu
+
+    rng = np.random.RandomState(0)
+    if location is None:
+        exe = sym.simple_bind(ctx or _cpu(), grad_req=grad_req, **kwargs)
+        location = {k: rng.normal(size=arr.shape, scale=1.0)
+                    .astype(np.float32) for k, arr in exe.arg_dict.items()}
+    else:
+        if not isinstance(location, dict):
+            raise TypeError("Expect dict, got location=%r" % (location,))
+        if kwargs:
+            raise ValueError(
+                "pass EITHER location (shapes come from its arrays) or "
+                "shape kwargs, not both: %s" % sorted(kwargs))
+        exe = sym.simple_bind(ctx or _cpu(), grad_req=grad_req,
+                              **{k: v.shape for k, v in location.items()})
+    for name, arr in location.items():
+        exe.arg_dict[name][:] = arr
+
+    if typ == "whole":
+        def run():
+            exe.forward(is_train=True)
+            exe.backward()
+    elif typ == "forward":
+        def run():
+            exe.forward(is_train=False)
+    else:
+        raise ValueError("typ can only be whole or forward")
+    run()
+    nd.waitall()
+    tic = time.time()
+    for _ in range(N):
+        run()
+    nd.waitall()
+    return (time.time() - tic) / N
 
 
 class DummyIter:

@@ -113,3 +113,17 @@ def test_doc_registries():
         mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3),
         data=(2, 5))
     assert list(shapes.values())[0] == (2, 3)
+
+
+def test_check_speed():
+    from mxnet_tpu.test_utils import check_speed
+
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4),
+        name="softmax")
+    t_whole = check_speed(net, N=2, data=(8, 5), softmax_label=(8,))
+    t_fwd = check_speed(net, N=2, typ="forward", data=(8, 5),
+                        softmax_label=(8,))
+    assert t_whole > 0 and t_fwd > 0
+    with pytest.raises(ValueError, match="typ"):
+        check_speed(net, N=1, typ="bogus", data=(8, 5), softmax_label=(8,))
