@@ -234,6 +234,8 @@ def check_speed(sym: Symbol, location=None, ctx=None, N=20,
     from . import ndarray as nd
     from .context import cpu as _cpu
 
+    if typ not in ("whole", "forward"):
+        raise ValueError("typ can only be whole or forward")
     rng = np.random.RandomState(0)
     if location is None:
         exe = sym.simple_bind(ctx or _cpu(), grad_req=grad_req, **kwargs)
@@ -255,11 +257,9 @@ def check_speed(sym: Symbol, location=None, ctx=None, N=20,
         def run():
             exe.forward(is_train=True)
             exe.backward()
-    elif typ == "forward":
+    else:  # "forward", validated above
         def run():
             exe.forward(is_train=False)
-    else:
-        raise ValueError("typ can only be whole or forward")
     run()
     nd.waitall()
     tic = time.time()
