@@ -17,17 +17,11 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=1)
-    ap.add_argument("--heads", type=int, default=8)
-    ap.add_argument("--head-dim", type=int, default=128)
-    ap.add_argument("--seq", type=int, default=16384)
-    ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--block-q", type=int, default=512)
-    ap.add_argument("--block-k", type=int, default=1024)
-    cli = ap.parse_args()
-
+def run_bench(batch=1, heads=8, head_dim=128, seq=16384, steps=10,
+              block_q=512, block_k=1024):
+    """Time the causal flash-attention train step; returns the record dict.
+    Importable so bench.py can measure in-process (the TPU is held by one
+    process — a subprocess could not claim it)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -35,8 +29,8 @@ def main():
     from mxnet_tpu.ops.attention import flash_attention
 
     on_tpu = jax.default_backend() == "tpu"
-    b, h, d = cli.batch, cli.heads, cli.head_dim
-    s = cli.seq if on_tpu else min(cli.seq, 512)
+    b, h, d = batch, heads, head_dim
+    s = seq if on_tpu else min(seq, 512)
     dt = jnp.bfloat16 if on_tpu else jnp.float32
     key = jax.random.PRNGKey(0)
     q = jax.random.normal(key, (b, s, h, d), dt) * 0.1
@@ -44,8 +38,8 @@ def main():
     v = jax.random.normal(key, (b, s, h, d), dt) * 0.1
 
     def loss(q, k, v):
-        o = flash_attention(q, k, v, causal=True, block_q=cli.block_q,
-                            block_k=cli.block_k)
+        o = flash_attention(q, k, v, causal=True, block_q=block_q,
+                            block_k=block_k)
         return jnp.mean(o.astype(jnp.float32) ** 2)
 
     step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
@@ -56,22 +50,38 @@ def main():
     q = chain(q, g[0])
     np.asarray(q[0, 0, 0, 0])
     t0 = time.time()
-    for _ in range(cli.steps):
+    for _ in range(steps):
         g = step(q, k, v)
         q = chain(q, g[0])
     np.asarray(q[0, 0, 0, 0])
-    dt_s = (time.time() - t0) / cli.steps
+    dt_s = (time.time() - t0) / steps
 
     fwd_flops = 0.5 * 4.0 * b * h * s * s * d  # causal: half the s^2 grid
     total = 3.0 * fwd_flops
     peak = 197e12 if on_tpu else None
-    print(json.dumps({
+    return {
         "metric": "flash_attention_train_tflops",
         "value": round(total / dt_s / 1e12, 2), "unit": "TFLOP/s",
         "seq": s, "batch": b, "heads": h, "head_dim": d,
         "step_ms": round(dt_s * 1e3, 2),
         "mfu": round(total / dt_s / peak, 4) if peak else None,
-        "backend": jax.default_backend()}))
+        "backend": jax.default_backend()}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--seq", type=int, default=16384)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--block-q", type=int, default=512)
+    ap.add_argument("--block-k", type=int, default=1024)
+    cli = ap.parse_args()
+    print(json.dumps(run_bench(
+        batch=cli.batch, heads=cli.heads, head_dim=cli.head_dim,
+        seq=cli.seq, steps=cli.steps, block_q=cli.block_q,
+        block_k=cli.block_k)))
 
 
 if __name__ == "__main__":
