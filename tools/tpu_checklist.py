@@ -14,7 +14,6 @@ Usage: python tools/tpu_checklist.py [--skip-resnet]
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -63,14 +62,44 @@ def main():
         report("flash_vs_oracle", causal=causal, fwd_maxerr=round(err, 5),
                bwd_maxerr=round(gerr, 5), ok=err < 0.02 and gerr < 0.02)
 
-    # 2. throughput ladder at --seq
-    res = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "tools", "bench_attention.py"),
-         "--seq", str(cli.seq), "--steps", "10"],
-        capture_output=True, text=True, timeout=1200)
-    line = res.stdout.strip().splitlines()[-1] if res.stdout.strip() else ""
-    report("flash_train_bench", result=json.loads(line) if line else None,
-           ok=res.returncode == 0)
+    # 2. throughput ladder at --seq, swept over block shapes (in-process;
+    # the chip belongs to this process)
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import contextlib
+    import signal
+
+    from bench_attention import run_bench
+
+    @contextlib.contextmanager
+    def deadline(seconds):
+        # a wedged compile on a flaky chip must not stall the whole
+        # checklist (this tool exists to validate recovered chips)
+        def _raise(signum, frame):
+            raise TimeoutError("exceeded %ds" % seconds)
+
+        old = signal.signal(signal.SIGALRM, _raise)
+        signal.alarm(seconds)
+        try:
+            yield
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+
+    best = None
+    for bq, bk in ((512, 512), (512, 1024), (1024, 512)):
+        try:
+            with deadline(900):
+                r = run_bench(seq=cli.seq, steps=10, block_q=bq, block_k=bk)
+            report("flash_train_bench", block_q=bq, block_k=bk, result=r,
+                   ok=True)
+            if best is None or r["value"] > best["value"]:
+                best = dict(r, block_q=bq, block_k=bk)
+        except Exception as e:
+            report("flash_train_bench", block_q=bq, block_k=bk, ok=False,
+                   error=str(e)[:200])
+    if best is not None:
+        report("flash_train_best", tflops=best["value"], mfu=best["mfu"],
+               block_q=best["block_q"], block_k=best["block_k"], ok=True)
 
     # 3. 16k-token causal train step on one chip
     s16 = 16384
@@ -103,15 +132,21 @@ def main():
     jax.block_until_ready(gring)
     report("ring_flash_tpu_vma", fwd_maxerr=round(rerr, 5), ok=rerr < 0.02)
 
-    # 5. headline bench
+    # 5. headline bench — in-process (same TPU-lock constraint as check 2);
+    # bench.main prints its own JSON line
     if not cli.skip_resnet:
-        res = subprocess.run([sys.executable,
-                              os.path.join(ROOT, "bench.py")],
-                             capture_output=True, text=True, timeout=3000)
-        line = res.stdout.strip().splitlines()[-1] if res.stdout.strip() \
-            else ""
-        report("resnet50_bench", result=json.loads(line) if line else None,
-               ok=res.returncode == 0)
+        import bench
+
+        argv = sys.argv
+        sys.argv = ["bench.py"]
+        try:
+            with deadline(3000):
+                bench.main()
+            report("resnet50_bench", ok=True)
+        except Exception as e:
+            report("resnet50_bench", ok=False, error=str(e)[:200])
+        finally:
+            sys.argv = argv
 
 
 if __name__ == "__main__":
