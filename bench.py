@@ -35,6 +35,8 @@ def main():
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--dtype", default="bfloat16",
                     choices=["bfloat16", "float32"])
+    ap.add_argument("--skip-attention", action="store_true",
+                    help="omit the secondary flash-attention metric")
     cli = ap.parse_args()
 
     import jax
@@ -59,10 +61,11 @@ def main():
     stats = fit.benchmark(args, net, num_steps=steps, warmup=warmup)
 
     if not stats.get("finite", True):
-        print(json.dumps({"metric": "resnet50_train_throughput", "value": 0.0,
-                          "unit": "img/s", "vs_baseline": 0.0,
-                          "error": "non-finite parameters after training"}))
-        return
+        record = {"metric": "resnet50_train_throughput", "value": 0.0,
+                  "unit": "img/s", "vs_baseline": 0.0,
+                  "error": "non-finite parameters after training"}
+        print(json.dumps(record))
+        return record
 
     img_per_sec = stats["img_per_sec"]
     # ResNet-50 fwd ~= 4.09 GFLOP/img at 224x224; train ~= 3x fwd
@@ -81,7 +84,7 @@ def main():
         "mfu": round(mfu, 4) if mfu is not None else None,
         "path": "module",
     }
-    if backend == "tpu":
+    if backend == "tpu" and not cli.skip_attention:
         # secondary metric: the high-MFU path (flash-attention train step;
         # PERF.md's transformer story). In-process — the TPU is held by
         # this process, a subprocess could not claim it. Never allowed to
@@ -98,6 +101,7 @@ def main():
             print("flash-attention secondary bench failed: %r" % (e,),
                   file=sys.stderr)
     print(json.dumps(record))
+    return record
 
 
 if __name__ == "__main__":

@@ -1,0 +1,138 @@
+"""LSTM + CTC sequence labeling (reference: example/warpctc/lstm_ocr.py,
+the warp-ctc plugin's showcase — captcha OCR there; a generated
+frame-stream task here so the example runs without image assets).
+
+Task: each sample is a digit string rendered as a stream of noisy frames
+(each symbol held for a random number of frames, blanks between); the
+model reads the frames with an LSTM and is trained with the ``WarpCTC``
+loss (blank=0) to emit the digit string. Greedy CTC decoding (collapse
+repeats, drop blanks) measures sequence accuracy.
+
+Usage: python lstm_ocr.py [--num-epochs 10]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def make_dataset(n_samples, seq_len, label_len, n_classes, feat_dim,
+                 seed=0):
+    """Frames: a fixed random template per symbol + noise; labels padded
+    with 0 (the CTC blank)."""
+    rng = np.random.RandomState(seed)
+    templates = rng.randn(n_classes + 1, feat_dim).astype(np.float32)
+    X = np.zeros((n_samples, seq_len, feat_dim), np.float32)
+    Y = np.zeros((n_samples, label_len), np.float32)
+    for i in range(n_samples):
+        n_sym = rng.randint(1, label_len + 1)
+        syms = rng.randint(1, n_classes + 1, size=n_sym)
+        Y[i, :n_sym] = syms
+        t = 0
+        for s_ in syms:
+            hold = rng.randint(2, 4)
+            for _ in range(hold):
+                if t >= seq_len:
+                    break
+                X[i, t] = templates[s_] + rng.randn(feat_dim) * 0.3
+                t += 1
+            if t < seq_len and rng.rand() < 0.5:
+                X[i, t] = templates[0] + rng.randn(feat_dim) * 0.3  # blank
+                t += 1
+    return X, Y
+
+
+def build_net(seq_len, label_len, num_hidden, n_classes):
+    data = mx.sym.Variable("data")          # (N, T, F)
+    label = mx.sym.Variable("label")        # (N, L)
+    cell = mx.rnn.LSTMCell(num_hidden=num_hidden, prefix="lstm_")
+    outputs, _ = cell.unroll(seq_len, inputs=data, layout="NTC",
+                             merge_outputs=True)       # (N, T, H)
+    tm = mx.sym.transpose(outputs, axes=(1, 0, 2))     # (T, N, H) time-major
+    pred = mx.sym.Reshape(tm, shape=(-1, num_hidden))  # (T*N, H)
+    pred = mx.sym.FullyConnected(pred, num_hidden=n_classes + 1,
+                                 name="pred")          # (T*N, P)
+    return mx.sym.WarpCTC(data=pred, label=label, label_length=label_len,
+                          input_length=seq_len)
+
+
+def ctc_greedy_decode(probs, seq_len, n_batch):
+    """probs: (T*N, P) time-major softmax -> list of decoded label lists
+    (collapse repeats, drop blanks)."""
+    path = probs.reshape(seq_len, n_batch, -1).argmax(-1)  # (T, N)
+    out = []
+    for n in range(n_batch):
+        prev, dec = -1, []
+        for t in range(seq_len):
+            c = int(path[t, n])
+            if c != prev and c != 0:
+                dec.append(c)
+            prev = c
+        out.append(dec)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=20)
+    ap.add_argument("--label-len", type=int, default=4)
+    ap.add_argument("--num-classes", type=int, default=10)
+    ap.add_argument("--feat-dim", type=int, default=16)
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-samples", type=int, default=480)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    args = ap.parse_args(argv)
+
+    X, Y = make_dataset(args.num_samples, args.seq_len, args.label_len,
+                        args.num_classes, args.feat_dim)
+    it = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size,
+                           shuffle=True, label_name="label")
+    net = build_net(args.seq_len, args.label_len, args.num_hidden,
+                    args.num_classes)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",))
+
+    def ctc_acc(labels, preds):
+        """Greedy-decode sequence accuracy (the reference example's custom
+        metric shape: feval over (labels, softmax))."""
+        n = labels.shape[0]
+        decoded = ctc_greedy_decode(preds, args.seq_len, n)
+        hits = sum(int(decoded[i] ==
+                       [int(v) for v in labels[i] if v != 0])
+                   for i in range(n))
+        return hits / float(n)
+
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            eval_metric=mx.metric.np(ctc_acc, allow_extra_outputs=True),
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+
+    # sequence accuracy via greedy CTC decode
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        probs = mod.get_outputs()[0].asnumpy()
+        decoded = ctc_greedy_decode(probs, args.seq_len, args.batch_size)
+        labels = batch.label[0].asnumpy()
+        for n in range(args.batch_size):
+            want = [int(v) for v in labels[n] if v != 0]
+            correct += int(decoded[n] == want)
+            total += 1
+    acc = correct / total
+    print({"metric": "ctc_sequence_accuracy", "value": round(acc, 4)})
+    return acc
+
+
+if __name__ == "__main__":
+    main()

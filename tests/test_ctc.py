@@ -115,3 +115,20 @@ def test_warpctc_training_drives_loss_down():
         data[:] = data - 0.5 * grad
     after = loss_now()
     assert after < before * 0.5, (before, after)
+
+
+def test_lstm_ocr_example_learns():
+    """The warpctc example end-to-end (reference example/warpctc/
+    lstm_ocr.py): LSTM + WarpCTC on a generated frame-stream task;
+    greedy-decode sequence accuracy far above chance."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."))
+    from examples.warpctc import lstm_ocr
+
+    acc = lstm_ocr.main(["--num-epochs", "5", "--num-samples", "192",
+                         "--seq-len", "16", "--label-len", "3",
+                         "--num-classes", "6", "--num-hidden", "48"])
+    assert acc > 0.5, acc
