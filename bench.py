@@ -90,8 +90,10 @@ def main():
         # this process, a subprocess could not claim it. Never allowed to
         # break the headline.
         try:
-            sys.path.insert(0, os.path.join(
-                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            tools_dir = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools")
+            if tools_dir not in sys.path:
+                sys.path.insert(0, tools_dir)
             from bench_attention import run_bench
 
             att = run_bench(seq=8192, steps=5)

@@ -8,12 +8,13 @@
  *
  * Conventions: every function returns 0 on success, -1 on failure with
  * the message readable via MXTPUGetLastError() (thread-local).  Handles
- * are opaque.  Returned ARRAY STORAGE (shape buffers, name tables, the
- * handle-list vector itself) is owned by the library and valid until the
- * next call on the same thread — copy what you need.  Each individual
- * NDArrayHandle returned by MXTPUNDArrayLoad / MXTPUImperativeInvoke is
- * owned by the CALLER and must be released with MXTPUNDArrayFree, or the
- * backing array stays alive for the process lifetime.
+ * are opaque.  Returned SCALAR/STRING STORAGE (shape buffers, name
+ * tables) is owned by the library and valid only until the next call on
+ * the same thread — copy what you need.  Returned HANDLE ARRAYS from
+ * MXTPUNDArrayLoad / MXTPUImperativeInvoke are freshly allocated per
+ * call: the caller releases the array with MXTPUFreeHandleArray and each
+ * individual NDArrayHandle with MXTPUNDArrayFree (unreleased handles
+ * keep their backing arrays alive for the process lifetime).
  *
  * dtype flags are the reference's mshadow enum: 0=float32 1=float64
  * 2=float16 3=uint8 4=int32 5=int8 6=int64.
@@ -62,17 +63,27 @@ int MXTPUNDArraySave(const char* fname, mx_uint num_args,
                      NDArrayHandle* args, const char** keys);
 
 /* Load a .params file.  *out_names has *out_name_size entries (0 for a
- * list container). */
+ * list container).  *out_arr is a freshly allocated array; the caller
+ * owns both the array (release with MXTPUFreeHandleArray) and each
+ * handle in it (release with MXTPUNDArrayFree).  *out_names, however,
+ * is thread-local string storage valid only until the next call on this
+ * thread — copy the names out before making further calls. */
 int MXTPUNDArrayLoad(const char* fname, mx_uint* out_size,
                      NDArrayHandle** out_arr, mx_uint* out_name_size,
                      const char*** out_names);
+
+/* Release a handle array returned by MXTPUNDArrayLoad /
+ * MXTPUImperativeInvoke (the handles themselves are freed separately
+ * via MXTPUNDArrayFree). */
+int MXTPUFreeHandleArray(NDArrayHandle* arr);
 
 /* All registered operator names. */
 int MXTPUListAllOpNames(mx_uint* out_size, const char*** out_array);
 
 /* Invoke a registered op imperatively.  Attr values are strings, parsed
  * by the op's declarative parameter specs (the attr_parser contract).
- * *outputs is library-owned. */
+ * *outputs is a freshly allocated array; caller releases it with
+ * MXTPUFreeHandleArray and each handle with MXTPUNDArrayFree. */
 int MXTPUImperativeInvoke(const char* op_name, int num_inputs,
                           NDArrayHandle* inputs, int* num_outputs,
                           NDArrayHandle** outputs, int num_params,

@@ -475,8 +475,10 @@ def _layer_norm(opctx, attrs, data, gamma, beta):
     out = norm * gamma.reshape(bshape).astype(data.dtype) \
         + beta.reshape(bshape).astype(data.dtype)
     if attrs.get("output_mean_var"):
+        # upstream's third output is the standard deviation, not the
+        # variance (mxnet layer_norm-inl.h contract: out, mean, std)
         return (out, jnp.squeeze(mean, axis).astype(data.dtype),
-                jnp.squeeze(var, axis).astype(data.dtype))
+                jnp.squeeze(jnp.sqrt(var + eps), axis).astype(data.dtype))
     return out
 
 

@@ -220,8 +220,11 @@ def _ring_flash_bwd(q, k, v, o, lse, do, *, axis, vary_axes, n_shards,
                                    pre=pre)
 
         def go_skip(_):
-            z = _vary(jnp.zeros((b, sq, h, d), q.dtype))
-            return z, z, z
+            # zeros_like tracks the compute branches' shape AND dtype
+            # (dq/dk/dv come back in q/k/v dtype; lax.switch requires
+            # identical branch signatures for mixed-precision q vs k/v)
+            return (_vary(jnp.zeros_like(q)), _vary(jnp.zeros_like(k)),
+                    _vary(jnp.zeros_like(v)))
 
         if causal:
             branch = jnp.where(k_idx == idx, 0,

@@ -31,7 +31,6 @@ struct NDHandle {
 thread_local std::vector<mx_uint> t_shape;
 thread_local std::vector<std::string> t_names_store;
 thread_local std::vector<const char*> t_names;
-thread_local std::vector<void*> t_handles;
 
 }  // namespace
 
@@ -167,10 +166,24 @@ int MXTPUNDArrayLoad(const char* fname, mx_uint* out_size, void*** out_arr,
   PyObject* hids = PyTuple_GET_ITEM(res, 0);
   PyObject* names = PyTuple_GET_ITEM(res, 1);
   Py_ssize_t n = PyList_Size(hids);
-  t_handles.resize(n);
+  if (n < 0) {
+    PyErr_Clear();
+    Py_DECREF(res);
+    set_error("MXTPUNDArrayLoad: shim returned a non-list");
+    return -1;
+  }
+  // fresh malloc'd array per call: the handles inside are caller-owned
+  // already, so the array that is their only copy must not be a shared
+  // thread-local that the next Load/Invoke silently overwrites
+  // (n+1 so a zero-entry load never trips the malloc(0)-may-be-NULL case)
+  void** arr = static_cast<void**>(malloc((n + 1) * sizeof(void*)));
+  if (!arr) {
+    Py_DECREF(res);
+    set_error("MXTPUNDArrayLoad: allocation failed");
+    return -1;
+  }
   for (Py_ssize_t i = 0; i < n; ++i) {
-    t_handles[i] =
-        new NDHandle{PyLong_AsLongLong(PyList_GET_ITEM(hids, i))};
+    arr[i] = new NDHandle{PyLong_AsLongLong(PyList_GET_ITEM(hids, i))};
   }
   Py_ssize_t nn = PyList_Size(names);
   t_names_store.resize(nn);
@@ -181,7 +194,7 @@ int MXTPUNDArrayLoad(const char* fname, mx_uint* out_size, void*** out_arr,
   }
   Py_DECREF(res);
   *out_size = static_cast<mx_uint>(n);
-  *out_arr = t_handles.data();
+  *out_arr = arr;
   *out_name_size = static_cast<mx_uint>(nn);
   *out_names = t_names.data();
   return 0;
@@ -227,14 +240,29 @@ int MXTPUImperativeInvoke(const char* op_name, int num_inputs, void** inputs,
   Py_DECREF(vals);
   if (!res) return -1;
   Py_ssize_t n = PyList_Size(res);
-  t_handles.resize(n);
+  if (n < 0) {
+    PyErr_Clear();
+    Py_DECREF(res);
+    set_error("MXTPUImperativeInvoke: shim returned a non-list");
+    return -1;
+  }
+  void** arr = static_cast<void**>(malloc((n + 1) * sizeof(void*)));
+  if (!arr) {
+    Py_DECREF(res);
+    set_error("MXTPUImperativeInvoke: allocation failed");
+    return -1;
+  }
   for (Py_ssize_t i = 0; i < n; ++i) {
-    t_handles[i] =
-        new NDHandle{PyLong_AsLongLong(PyList_GET_ITEM(res, i))};
+    arr[i] = new NDHandle{PyLong_AsLongLong(PyList_GET_ITEM(res, i))};
   }
   Py_DECREF(res);
   *num_outputs = static_cast<int>(n);
-  *outputs = t_handles.data();
+  *outputs = arr;
+  return 0;
+}
+
+int MXTPUFreeHandleArray(void** arr) {
+  free(arr);
   return 0;
 }
 

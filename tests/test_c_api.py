@@ -183,9 +183,8 @@ def test_core_c_api_ndarray_and_invoke(tmp_path):
         b"sum", 1, ins, ctypes.byref(n_out), ctypes.byref(outs),
         1, keys, vals) == 0, lib.MXTPUGetLastError()
     assert n_out.value == 1
-    # the handle-list vector is only valid until the next call on this
-    # thread (header contract) — capture the handle value now
     sum_h = ctypes.c_void_p(outs[0])
+    lib.MXTPUFreeHandleArray(outs)
     out = np.zeros(2, np.float32)
     assert lib.MXTPUNDArraySyncCopyToCPU(
         sum_h, out.ctypes.data_as(ctypes.c_void_p), out.nbytes) == 0
@@ -205,6 +204,7 @@ def test_core_c_api_ndarray_and_invoke(tmp_path):
     assert n_arr.value == 1 and n_names.value == 1
     assert out_names[0] == b"w"
     loaded_h = ctypes.c_void_p(arrs[0])
+    lib.MXTPUFreeHandleArray(arrs)
     back = np.zeros((2, 3), np.float32)
     assert lib.MXTPUNDArraySyncCopyToCPU(
         loaded_h, back.ctypes.data_as(ctypes.c_void_p), back.nbytes) == 0

@@ -42,9 +42,11 @@ def test_layer_norm_output_mean_var():
                              "gamma": mx.nd.ones((5,)),
                              "beta": mx.nd.zeros((5,))})
     ex.forward(is_train=False)
-    out, mean, var = (o.asnumpy() for o in ex.outputs)
+    out, mean, std = (o.asnumpy() for o in ex.outputs)
     np.testing.assert_allclose(mean, x.mean(-1), rtol=1e-5, atol=1e-6)
-    np.testing.assert_allclose(var, x.var(-1), rtol=1e-4, atol=1e-5)
+    # upstream's third output is the standard deviation (out, mean, std)
+    np.testing.assert_allclose(std, np.sqrt(x.var(-1) + 1e-5),
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_gelu_erf_ops():

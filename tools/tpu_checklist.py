@@ -113,8 +113,10 @@ def main():
     t0 = time.time()
     g16 = step(q16)
     jax.block_until_ready(g16)
-    report("flash_16k_train_step", first_step_s=round(time.time() - t0, 1),
-           finite=bool(jnp.isfinite(g16.astype(jnp.float32)).all()), ok=True)
+    dt16 = time.time() - t0
+    fin16 = bool(jnp.isfinite(g16.astype(jnp.float32)).all())
+    report("flash_16k_train_step", first_step_s=round(dt16, 1),
+           finite=fin16, ok=fin16)
 
     # 4. ring-flash causal traces under the TPU vma checker (all lax.switch
     # branches are traced even on a 1-device mesh)
@@ -133,7 +135,9 @@ def main():
         q, q, q, mesh, axis="seq", causal=True, block_q=128,
         block_k=128).astype(jnp.float32) ** 2))(qr)
     jax.block_until_ready(gring)
-    report("ring_flash_tpu_vma", fwd_maxerr=round(rerr, 5), ok=rerr < 0.02)
+    gfin = bool(jnp.isfinite(gring.astype(jnp.float32)).all())
+    report("ring_flash_tpu_vma", fwd_maxerr=round(rerr, 5),
+           grad_finite=gfin, ok=(rerr < 0.02 and gfin))
 
     # 5. headline bench — in-process (same TPU-lock constraint as check 2);
     # bench.main prints its own JSON line
