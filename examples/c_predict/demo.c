@@ -55,7 +55,8 @@ int main(int argc, char** argv) {
 
   float* data = (float*)malloc(sizeof(float) * batch * dim);
   for (mx_uint i = 0; i < batch * dim; ++i) {
-    data[i] = (float)((i % 7) - 3) / 3.0f;  /* deterministic pattern */
+    /* (int) before the subtraction: i is unsigned, (i%7)-3 would wrap */
+    data[i] = ((float)(int)(i % 7) - 3.0f) / 3.0f;
   }
   if (MXTPUPredSetInput(h, "data", data, batch * dim) != 0 ||
       MXTPUPredForward(h) != 0) {

@@ -1,0 +1,150 @@
+/* Standalone C consumer of the SYMBOL/EXECUTOR ABI — unlike demo.c (which
+ * uses the fixed-function predict API), this builds the graph from JSON,
+ * infers shapes, binds NDArrays and runs the executor: the full
+ * MXSymbolCreateFromJSON -> MXExecutorBind -> MXExecutorForward flow a
+ * language binding would use (reference: c_api_symbolic.cc:54-545,
+ * c_api_executor.cc:11-157).  The process starts with NO Python;
+ * libmxtpu_capi.so embeds the interpreter.
+ *
+ * Usage: demo_symbol <prefix> <epoch> <batch> <dim>
+ * Reads <prefix>-symbol.json + <prefix>-<epoch 04d>.params, feeds a
+ * deterministic batch, prints the first output row as CSV.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu/c_api.h"
+
+#define CHECK(rc)                                                     \
+  do {                                                                \
+    if ((rc) != 0) {                                                  \
+      fprintf(stderr, "error: %s\n", MXTPUGetLastError());            \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s prefix epoch batch dim\n", argv[0]);
+    return 2;
+  }
+  const char* prefix = argv[1];
+  int epoch = atoi(argv[2]);
+  mx_uint batch = (mx_uint)atoi(argv[3]);
+  mx_uint dim = (mx_uint)atoi(argv[4]);
+  char path[512];
+
+  /* graph from the -symbol.json file */
+  snprintf(path, sizeof path, "%s-symbol.json", prefix);
+  SymbolHandle sym = NULL;
+  CHECK(MXTPUSymbolCreateFromFile(path, &sym));
+
+  mx_uint n_args = 0;
+  const char** arg_names_tl = NULL;
+  CHECK(MXTPUSymbolListArguments(sym, &n_args, &arg_names_tl));
+  /* copy out: name tables are thread-local, next call invalidates them */
+  char** arg_names = (char**)malloc(n_args * sizeof(char*));
+  for (mx_uint i = 0; i < n_args; ++i) arg_names[i] = strdup(arg_names_tl[i]);
+
+  /* shapes for every argument from the input shape alone */
+  const char* keys[2] = {"data", "softmax_label"};
+  mx_uint indptr[3] = {0, 2, 3};
+  mx_uint sdata[3] = {batch, dim, batch};
+  mx_uint in_size, out_size_s, aux_size;
+  const mx_uint *in_ndim, *out_ndim, *aux_ndim;
+  const mx_uint **in_data, **out_data, **aux_data;
+  int complete = 0;
+  CHECK(MXTPUSymbolInferShape(sym, 2, keys, indptr, sdata, &in_size,
+                              &in_ndim, &in_data, &out_size_s, &out_ndim,
+                              &out_data, &aux_size, &aux_ndim, &aux_data,
+                              &complete));
+  if (!complete || in_size != n_args) {
+    fprintf(stderr, "shape inference incomplete\n");
+    return 1;
+  }
+  /* copy the arg shapes out of thread-local storage before further calls */
+  mx_uint* arg_ndim = (mx_uint*)malloc(n_args * sizeof(mx_uint));
+  mx_uint** arg_shape = (mx_uint**)malloc(n_args * sizeof(mx_uint*));
+  for (mx_uint i = 0; i < n_args; ++i) {
+    arg_ndim[i] = in_ndim[i];
+    arg_shape[i] = (mx_uint*)malloc(in_ndim[i] * sizeof(mx_uint));
+    memcpy(arg_shape[i], in_data[i], in_ndim[i] * sizeof(mx_uint));
+  }
+
+  /* weights from the checkpoint (keys are "arg:<name>" / "aux:<name>") */
+  snprintf(path, sizeof path, "%s-%04d.params", prefix, epoch);
+  mx_uint n_loaded = 0, n_names = 0;
+  NDArrayHandle* loaded = NULL;
+  const char** loaded_names_tl = NULL;
+  CHECK(MXTPUNDArrayLoad(path, &n_loaded, &loaded, &n_names,
+                         &loaded_names_tl));
+  char** loaded_names = (char**)malloc(n_names * sizeof(char*));
+  for (mx_uint i = 0; i < n_names; ++i)
+    loaded_names[i] = strdup(loaded_names_tl[i]);
+
+  /* one NDArray per argument: checkpoint weight if named, zeros for the
+   * data/label inputs */
+  NDArrayHandle* args = (NDArrayHandle*)calloc(n_args, sizeof(NDArrayHandle));
+  int* from_ckpt = (int*)calloc(n_args, sizeof(int));
+  for (mx_uint i = 0; i < n_args; ++i) {
+    for (mx_uint j = 0; j < n_loaded; ++j) {
+      const char* nm = loaded_names[j];
+      if (strncmp(nm, "arg:", 4) == 0 && strcmp(nm + 4, arg_names[i]) == 0) {
+        args[i] = loaded[j];
+        from_ckpt[i] = 1;
+        break;
+      }
+    }
+    if (!args[i]) {
+      CHECK(MXTPUNDArrayCreate(arg_shape[i], arg_ndim[i], 1, 0, 0,
+                               &args[i]));
+    }
+  }
+
+  /* deterministic input batch, same pattern as demo.c */
+  size_t n_in = (size_t)batch * dim;
+  float* x = (float*)malloc(n_in * sizeof(float));
+  /* (int) before the subtraction: i is unsigned, (i%7)-3 would wrap */
+  for (size_t i = 0; i < n_in; ++i)
+    x[i] = ((float)(int)(i % 7) - 3.0f) * 0.25f;
+  for (mx_uint i = 0; i < n_args; ++i) {
+    if (strcmp(arg_names[i], "data") == 0) {
+      CHECK(MXTPUNDArraySyncCopyFromCPU(args[i], x, n_in * sizeof(float)));
+    }
+  }
+
+  /* bind (no gradients — inference) and run */
+  ExecutorHandle ex = NULL;
+  CHECK(MXTPUExecutorBind(sym, 1, 0, n_args, args, NULL, NULL, 0, NULL,
+                          &ex));
+  CHECK(MXTPUExecutorForward(ex, 0));
+
+  mx_uint n_out = 0;
+  NDArrayHandle* outs = NULL;
+  CHECK(MXTPUExecutorOutputs(ex, &n_out, &outs));
+  mx_uint ndim = 0;
+  const mx_uint* oshape = NULL;
+  CHECK(MXTPUNDArrayGetShape(outs[0], &ndim, &oshape));
+  mx_uint cols = ndim >= 2 ? oshape[1] : 1;
+  size_t total = 1;
+  for (mx_uint i = 0; i < ndim; ++i) total *= oshape[i];
+  float* out = (float*)malloc(total * sizeof(float));
+  CHECK(MXTPUNDArraySyncCopyToCPU(outs[0], out, total * sizeof(float)));
+  for (mx_uint j = 0; j < cols; ++j) {
+    printf(j ? ",%g" : "%g", out[j]);
+  }
+  printf("\n");
+
+  for (mx_uint i = 0; i < n_out; ++i) MXTPUNDArrayFree(outs[i]);
+  MXTPUFreeHandleArray(outs);
+  MXTPUExecutorFree(ex);
+  /* every loaded handle is freed exactly once (some are also in args) */
+  for (mx_uint i = 0; i < n_args; ++i) {
+    if (!from_ckpt[i]) MXTPUNDArrayFree(args[i]);
+  }
+  for (mx_uint j = 0; j < n_loaded; ++j) MXTPUNDArrayFree(loaded[j]);
+  MXTPUFreeHandleArray(loaded);
+  MXTPUSymbolFree(sym);
+  return 0;
+}

@@ -89,6 +89,77 @@ int MXTPUImperativeInvoke(const char* op_name, int num_inputs,
                           NDArrayHandle** outputs, int num_params,
                           const char** param_keys, const char** param_vals);
 
+/* ------------------------------------------------------------------ */
+/* Symbol surface — build/inspect graphs from C with no Python setup.
+ * Reference analogue: c_api_symbolic.cc:54-545 (MXSymbolCreateFromJSON,
+ * MXSymbolListArguments/Outputs/AuxiliaryStates, MXSymbolInferShape). */
+
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+
+int MXTPUSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXTPUSymbolCreateFromFile(const char* fname, SymbolHandle* out);
+/* *out_json is thread-local storage, valid until the next call. */
+int MXTPUSymbolSaveToJSON(SymbolHandle sym, const char** out_json);
+/* Name tables are thread-local storage, valid until the next call. */
+int MXTPUSymbolListArguments(SymbolHandle sym, mx_uint* out_size,
+                             const char*** out_array);
+int MXTPUSymbolListOutputs(SymbolHandle sym, mx_uint* out_size,
+                           const char*** out_array);
+int MXTPUSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint* out_size,
+                                   const char*** out_array);
+int MXTPUSymbolFree(SymbolHandle sym);
+
+/* Infer all shapes from named input shapes in CSR form (the reference
+ * MXSymbolInferShape signature, c_api_symbolic.cc:408): keys[i] names an
+ * input whose shape is arg_shape_data[arg_ind_ptr[i] .. arg_ind_ptr[i+1]].
+ * On return the three (size, ndim, data) triples describe argument,
+ * output, and auxiliary shapes in declaration order; *complete is 0 when
+ * the provided shapes underdetermine the graph (the out pointers are
+ * then NULL).  All returned storage is thread-local, valid until the
+ * next call on this thread. */
+int MXTPUSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                          const char** keys, const mx_uint* arg_ind_ptr,
+                          const mx_uint* arg_shape_data,
+                          mx_uint* in_shape_size,
+                          const mx_uint** in_shape_ndim,
+                          const mx_uint*** in_shape_data,
+                          mx_uint* out_shape_size,
+                          const mx_uint** out_shape_ndim,
+                          const mx_uint*** out_shape_data,
+                          mx_uint* aux_shape_size,
+                          const mx_uint** aux_shape_ndim,
+                          const mx_uint*** aux_shape_data,
+                          int* complete);
+
+/* ------------------------------------------------------------------ */
+/* Executor surface — bind NDArrays to a symbol and run forward/backward.
+ * Reference analogue: c_api_executor.cc:11-157 (MXExecutorBind/Forward/
+ * Backward/Outputs).
+ *
+ * Bind contract: arg_handles are aligned with MXTPUSymbolListArguments
+ * order; aux_handles with MXTPUSymbolListAuxiliaryStates.  grad_handles
+ * may be NULL (no gradients) or an array where entry i is NULL or a
+ * buffer that MXTPUExecutorBackward fills IN PLACE for argument i.
+ * grad_req_types uses the reference OpReqType codes: 0=null 1=write
+ * 2=write-inplace 3=add. */
+int MXTPUExecutorBind(SymbolHandle sym, int dev_type, int dev_id,
+                      mx_uint num_args, NDArrayHandle* arg_handles,
+                      NDArrayHandle* grad_handles,
+                      const mx_uint* grad_req_types,
+                      mx_uint num_aux, NDArrayHandle* aux_handles,
+                      ExecutorHandle* out);
+int MXTPUExecutorForward(ExecutorHandle handle, int is_train);
+/* head_grads may be NULL (scalar-loss convention) or num_heads buffers
+ * aligned with the symbol's outputs. */
+int MXTPUExecutorBackward(ExecutorHandle handle, mx_uint num_heads,
+                          NDArrayHandle* head_grads);
+/* *out is a freshly allocated handle array (caller: MXTPUFreeHandleArray
+ * on the array, MXTPUNDArrayFree on each handle). */
+int MXTPUExecutorOutputs(ExecutorHandle handle, mx_uint* out_size,
+                         NDArrayHandle** out);
+int MXTPUExecutorFree(ExecutorHandle handle);
+
 #ifdef __cplusplus
 }
 #endif

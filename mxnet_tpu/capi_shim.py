@@ -42,6 +42,13 @@ class _HandleRegistry:
 _predictors = _HandleRegistry()
 
 
+def _ctx_from_dev(dev_type, dev_id=0):
+    """Reference dev_type codes (include/mxnet/base.h): 1=cpu, 2=gpu."""
+    from . import context as ctx_mod
+
+    return ctx_mod.Context("gpu" if dev_type == 2 else "cpu", dev_id)
+
+
 def create(symbol_json, params_bytes, input_keys, input_shapes, dev_type):
     """-> integer handle.  ``params_bytes``: a .params file image;
     ``input_shapes``: list of tuples aligned with ``input_keys``."""
@@ -59,8 +66,7 @@ def create(symbol_json, params_bytes, input_keys, input_shapes, dev_type):
         raise MXNetError(
             "params blob has no names (list container); save checkpoints "
             "as a name->array dict")
-    # reference dev_type codes (include/mxnet/base.h): 1=cpu, 2=gpu
-    ctx = ctx_mod.Context("gpu" if dev_type == 2 else "cpu")
+    ctx = _ctx_from_dev(dev_type)
     shapes = {k: tuple(int(d) for d in s)
               for k, s in zip(input_keys, input_shapes)}
     pred = Predictor(symbol_json, params, shapes, ctx=ctx)
@@ -128,13 +134,12 @@ def _nd_get(hid):
 
 
 def nd_create(shape, dev_type, dev_id, dtype_flag):
-    from . import context as ctx_mod
     from . import ndarray as nd
     from .ndarray import _FLAG_TYPE
 
-    ctx = ctx_mod.Context("gpu" if dev_type == 2 else "cpu", dev_id)
     return _nd_put(nd.zeros(tuple(int(d) for d in shape),
-                            ctx=ctx, dtype=_FLAG_TYPE[dtype_flag]))
+                            ctx=_ctx_from_dev(dev_type, dev_id),
+                            dtype=_FLAG_TYPE[dtype_flag]))
 
 
 def nd_free(hid):
@@ -204,3 +209,117 @@ def nd_invoke(op_name, in_hids, keys, vals):
         if not isinstance(o, NDArray):  # _invoke's contract; keep loud
             raise TypeError("op %s returned a non-NDArray output" % op_name)
     return [_nd_put(o) for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# Symbol / Executor C API backing (src/c_api.cc — the reference's
+# c_api_symbolic.cc:54-545 + c_api_executor.cc:11-157 surfaces).  A C
+# consumer can now build a graph from JSON, infer shapes, bind NDArrays,
+# and run forward/backward with no Python-side setup.
+# ---------------------------------------------------------------------------
+
+_symbols = _HandleRegistry()
+_executors = _HandleRegistry()
+
+# reference OpReqType codes (include/mxnet/op_attr_types.h): kNullOp=0,
+# kWriteTo=1, kWriteInplace=2, kAddTo=3
+_GRAD_REQ_CODE = {0: "null", 1: "write", 2: "write", 3: "add"}
+
+
+def sym_from_json(json_str):
+    from . import symbol
+
+    return _symbols.put(symbol.load_json(json_str))
+
+
+def sym_from_file(fname):
+    from . import symbol
+
+    return _symbols.put(symbol.load(fname))
+
+
+def _sym_get(hid):
+    return _symbols.get(hid, "Symbol")
+
+
+def sym_tojson(hid):
+    return _sym_get(hid).tojson()
+
+
+def sym_list_arguments(hid):
+    return list(_sym_get(hid).list_arguments())
+
+
+def sym_list_outputs(hid):
+    return list(_sym_get(hid).list_outputs())
+
+
+def sym_list_aux(hid):
+    return list(_sym_get(hid).list_auxiliary_states())
+
+
+def sym_free(hid):
+    _symbols.pop(hid)
+
+
+def sym_infer_shape(hid, keys, shapes):
+    """-> (arg_shapes, out_shapes, aux_shapes) as lists of int tuples, or
+    (None, None, None) when the provided shapes underdetermine the graph
+    (the reference's ``complete`` flag)."""
+    sym = _sym_get(hid)
+    kwargs = {k: tuple(int(d) for d in s) for k, s in zip(keys, shapes)}
+    arg, out, aux = sym.infer_shape_partial(**kwargs)
+    if (arg is None or out is None or aux is None
+            or any(s is None for s in arg + out + aux)):
+        return None, None, None
+    return ([tuple(map(int, s)) for s in arg],
+            [tuple(map(int, s)) for s in out],
+            [tuple(map(int, s)) for s in aux])
+
+
+def exec_bind(sym_hid, dev_type, dev_id, arg_hids, grad_hids,
+              grad_req_codes, aux_hids):
+    """Bind in ``list_arguments`` order (the reference MXExecutorBind
+    contract).  ``grad_hids`` entries of 0 mean no gradient buffer for
+    that argument; gradients are written IN PLACE into the caller's
+    NDArray handles by exec_backward."""
+    sym = _sym_get(sym_hid)
+    ctx = _ctx_from_dev(dev_type, dev_id)
+    names = sym.list_arguments()
+    args = [_nd_get(h) for h in arg_hids]
+    args_grad = {}
+    grad_req = {}
+    for name, ghid, code in zip(names, grad_hids, grad_req_codes):
+        req = _GRAD_REQ_CODE.get(int(code), "null")
+        grad_req[name] = req if ghid else "null"
+        if ghid and req != "null":
+            args_grad[name] = _nd_get(ghid)
+    aux = [_nd_get(h) for h in aux_hids]
+    ex = sym.bind(ctx, args, args_grad=args_grad or None,
+                  grad_req=grad_req, aux_states=aux or None)
+    return _executors.put(ex)
+
+
+def _exec_get(hid):
+    return _executors.get(hid, "Executor")
+
+
+def exec_forward(hid, is_train):
+    _exec_get(hid).forward(is_train=bool(is_train))
+
+
+def exec_backward(hid, head_hids):
+    ex = _exec_get(hid)
+    if head_hids:
+        ex.backward(out_grads=[_nd_get(h) for h in head_hids])
+    else:
+        ex.backward()
+
+
+def exec_outputs(hid):
+    """-> fresh NDArray registry handles for the executor outputs."""
+    return [_nd_put(o) for o in _exec_get(hid).outputs]
+
+
+def exec_free(hid):
+    _executors.pop(hid)

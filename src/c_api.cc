@@ -21,8 +21,15 @@ using mxtpu_capi::set_error_from_python;
 
 namespace {
 
-// NDArray handles are heap longs carrying the shim registry id.
+// Opaque handles are heap longs carrying the shim registry id (one
+// registry per object kind in capi_shim.py).
 struct NDHandle {
+  long long hid;
+};
+struct SymHandle {
+  long long hid;
+};
+struct ExecHandle {
   long long hid;
 };
 
@@ -31,6 +38,7 @@ struct NDHandle {
 thread_local std::vector<mx_uint> t_shape;
 thread_local std::vector<std::string> t_names_store;
 thread_local std::vector<const char*> t_names;
+thread_local std::string t_json;
 
 }  // namespace
 
@@ -263,6 +271,297 @@ int MXTPUImperativeInvoke(const char* op_name, int num_inputs, void** inputs,
 
 int MXTPUFreeHandleArray(void** arr) {
   free(arr);
+  return 0;
+}
+
+/* ------------------------------------------------------------------ */
+/* Symbol surface (shim: sym_* functions in capi_shim.py)              */
+
+int MXTPUSymbolCreateFromJSON(const char* json, void** out) {
+  ensure_python();
+  GIL gil;
+  PyObject* res = call_shim("sym_from_json", "(s)", json);
+  if (!res) return -1;
+  *out = new SymHandle{PyLong_AsLongLong(res)};
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUSymbolCreateFromFile(const char* fname, void** out) {
+  ensure_python();
+  GIL gil;
+  PyObject* res = call_shim("sym_from_file", "(s)", fname);
+  if (!res) return -1;
+  *out = new SymHandle{PyLong_AsLongLong(res)};
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUSymbolSaveToJSON(void* sym, const char** out_json) {
+  GIL gil;
+  PyObject* res =
+      call_shim("sym_tojson", "(L)", static_cast<SymHandle*>(sym)->hid);
+  if (!res) return -1;
+  t_json = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out_json = t_json.c_str();
+  return 0;
+}
+
+namespace {
+// Marshal a shim-returned list of strings into the shared thread-local
+// name table (library-owned, valid until the next call — header contract).
+int fill_name_table(PyObject* res, mx_uint* out_size,
+                    const char*** out_array) {
+  Py_ssize_t n = PyList_Size(res);
+  if (n < 0) {
+    PyErr_Clear();
+    Py_DECREF(res);
+    set_error("shim returned a non-list name table");
+    return -1;
+  }
+  t_names_store.resize(n);
+  t_names.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    t_names_store[i] = PyUnicode_AsUTF8(PyList_GET_ITEM(res, i));
+    t_names[i] = t_names_store[i].c_str();
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = t_names.data();
+  return 0;
+}
+}  // namespace
+
+int MXTPUSymbolListArguments(void* sym, mx_uint* out_size,
+                             const char*** out_array) {
+  GIL gil;
+  PyObject* res = call_shim("sym_list_arguments", "(L)",
+                            static_cast<SymHandle*>(sym)->hid);
+  if (!res) return -1;
+  return fill_name_table(res, out_size, out_array);
+}
+
+int MXTPUSymbolListOutputs(void* sym, mx_uint* out_size,
+                           const char*** out_array) {
+  GIL gil;
+  PyObject* res = call_shim("sym_list_outputs", "(L)",
+                            static_cast<SymHandle*>(sym)->hid);
+  if (!res) return -1;
+  return fill_name_table(res, out_size, out_array);
+}
+
+int MXTPUSymbolListAuxiliaryStates(void* sym, mx_uint* out_size,
+                                   const char*** out_array) {
+  GIL gil;
+  PyObject* res = call_shim("sym_list_aux", "(L)",
+                            static_cast<SymHandle*>(sym)->hid);
+  if (!res) return -1;
+  return fill_name_table(res, out_size, out_array);
+}
+
+int MXTPUSymbolFree(void* sym) {
+  auto* h = static_cast<SymHandle*>(sym);
+  if (!h) return 0;
+  {
+    GIL gil;
+    PyObject* res = call_shim("sym_free", "(L)", h->hid);
+    if (res) Py_DECREF(res);
+    else PyErr_Clear();
+  }
+  delete h;
+  return 0;
+}
+
+namespace {
+// One category of inferred shapes (args / outputs / aux), marshalled from
+// a shim list-of-tuples into stable thread-local storage.
+struct ShapeSet {
+  std::vector<std::vector<mx_uint>> store;
+  std::vector<mx_uint> ndims;
+  std::vector<const mx_uint*> ptrs;
+
+  void fill(PyObject* shapes) {
+    Py_ssize_t n = PyList_Size(shapes);
+    store.resize(n);
+    ndims.resize(n);
+    ptrs.resize(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* tup = PyList_GET_ITEM(shapes, i);
+      Py_ssize_t nd = PyTuple_Size(tup);
+      store[i].resize(nd);
+      for (Py_ssize_t j = 0; j < nd; ++j) {
+        store[i][j] = static_cast<mx_uint>(
+            PyLong_AsUnsignedLong(PyTuple_GET_ITEM(tup, j)));
+      }
+      ndims[i] = static_cast<mx_uint>(nd);
+      ptrs[i] = store[i].data();
+    }
+  }
+};
+
+thread_local ShapeSet t_arg_shapes, t_out_shapes, t_aux_shapes;
+}  // namespace
+
+int MXTPUSymbolInferShape(void* sym, mx_uint num_args, const char** keys,
+                          const mx_uint* arg_ind_ptr,
+                          const mx_uint* arg_shape_data,
+                          mx_uint* in_shape_size,
+                          const mx_uint** in_shape_ndim,
+                          const mx_uint*** in_shape_data,
+                          mx_uint* out_shape_size,
+                          const mx_uint** out_shape_ndim,
+                          const mx_uint*** out_shape_data,
+                          mx_uint* aux_shape_size,
+                          const mx_uint** aux_shape_ndim,
+                          const mx_uint*** aux_shape_data, int* complete) {
+  GIL gil;
+  PyObject* pkeys = PyList_New(num_args);
+  PyObject* pshapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(pkeys, i, PyUnicode_FromString(keys[i]));
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject* shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j) {
+      PyTuple_SET_ITEM(shp, j - lo,
+                       PyLong_FromUnsignedLong(arg_shape_data[j]));
+    }
+    PyList_SET_ITEM(pshapes, i, shp);
+  }
+  PyObject* res = call_shim("sym_infer_shape", "(LOO)",
+                            static_cast<SymHandle*>(sym)->hid, pkeys,
+                            pshapes);
+  Py_DECREF(pkeys);
+  Py_DECREF(pshapes);
+  if (!res) return -1;
+  PyObject* args_l = PyTuple_GET_ITEM(res, 0);
+  if (args_l == Py_None) {  // underdetermined: the reference's !complete
+    Py_DECREF(res);
+    *complete = 0;
+    *in_shape_size = *out_shape_size = *aux_shape_size = 0;
+    *in_shape_ndim = *out_shape_ndim = *aux_shape_ndim = nullptr;
+    *in_shape_data = *out_shape_data = *aux_shape_data = nullptr;
+    return 0;
+  }
+  t_arg_shapes.fill(args_l);
+  t_out_shapes.fill(PyTuple_GET_ITEM(res, 1));
+  t_aux_shapes.fill(PyTuple_GET_ITEM(res, 2));
+  Py_DECREF(res);
+  *complete = 1;
+  *in_shape_size = static_cast<mx_uint>(t_arg_shapes.ndims.size());
+  *in_shape_ndim = t_arg_shapes.ndims.data();
+  *in_shape_data = t_arg_shapes.ptrs.data();
+  *out_shape_size = static_cast<mx_uint>(t_out_shapes.ndims.size());
+  *out_shape_ndim = t_out_shapes.ndims.data();
+  *out_shape_data = t_out_shapes.ptrs.data();
+  *aux_shape_size = static_cast<mx_uint>(t_aux_shapes.ndims.size());
+  *aux_shape_ndim = t_aux_shapes.ndims.data();
+  *aux_shape_data = t_aux_shapes.ptrs.data();
+  return 0;
+}
+
+/* ------------------------------------------------------------------ */
+/* Executor surface (shim: exec_* functions in capi_shim.py)           */
+
+int MXTPUExecutorBind(void* sym, int dev_type, int dev_id, mx_uint num_args,
+                      void** arg_handles, void** grad_handles,
+                      const mx_uint* grad_req_types, mx_uint num_aux,
+                      void** aux_handles, void** out) {
+  GIL gil;
+  PyObject* pargs = PyList_New(num_args);
+  PyObject* pgrads = PyList_New(num_args);
+  PyObject* preqs = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(pargs, i, PyLong_FromLongLong(
+        static_cast<NDHandle*>(arg_handles[i])->hid));
+    void* g = grad_handles ? grad_handles[i] : nullptr;
+    PyList_SET_ITEM(pgrads, i, PyLong_FromLongLong(
+        g ? static_cast<NDHandle*>(g)->hid : 0));
+    PyList_SET_ITEM(preqs, i, PyLong_FromUnsignedLong(
+        grad_req_types ? grad_req_types[i] : 0));
+  }
+  PyObject* paux = PyList_New(num_aux);
+  for (mx_uint i = 0; i < num_aux; ++i) {
+    PyList_SET_ITEM(paux, i, PyLong_FromLongLong(
+        static_cast<NDHandle*>(aux_handles[i])->hid));
+  }
+  PyObject* res = call_shim("exec_bind", "(LiiOOOO)",
+                            static_cast<SymHandle*>(sym)->hid, dev_type,
+                            dev_id, pargs, pgrads, preqs, paux);
+  Py_DECREF(pargs);
+  Py_DECREF(pgrads);
+  Py_DECREF(preqs);
+  Py_DECREF(paux);
+  if (!res) return -1;
+  *out = new ExecHandle{PyLong_AsLongLong(res)};
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUExecutorForward(void* handle, int is_train) {
+  GIL gil;
+  PyObject* res = call_shim("exec_forward", "(Li)",
+                            static_cast<ExecHandle*>(handle)->hid, is_train);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUExecutorBackward(void* handle, mx_uint num_heads,
+                          void** head_grads) {
+  GIL gil;
+  PyObject* pheads = PyList_New(head_grads ? num_heads : 0);
+  if (head_grads) {
+    for (mx_uint i = 0; i < num_heads; ++i) {
+      PyList_SET_ITEM(pheads, i, PyLong_FromLongLong(
+          static_cast<NDHandle*>(head_grads[i])->hid));
+    }
+  }
+  PyObject* res = call_shim("exec_backward", "(LO)",
+                            static_cast<ExecHandle*>(handle)->hid, pheads);
+  Py_DECREF(pheads);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUExecutorOutputs(void* handle, mx_uint* out_size, void*** out) {
+  GIL gil;
+  PyObject* res = call_shim("exec_outputs", "(L)",
+                            static_cast<ExecHandle*>(handle)->hid);
+  if (!res) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  if (n < 0) {
+    PyErr_Clear();
+    Py_DECREF(res);
+    set_error("MXTPUExecutorOutputs: shim returned a non-list");
+    return -1;
+  }
+  void** arr = static_cast<void**>(malloc((n + 1) * sizeof(void*)));
+  if (!arr) {
+    Py_DECREF(res);
+    set_error("MXTPUExecutorOutputs: allocation failed");
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    arr[i] = new NDHandle{PyLong_AsLongLong(PyList_GET_ITEM(res, i))};
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(n);
+  *out = arr;
+  return 0;
+}
+
+int MXTPUExecutorFree(void* handle) {
+  auto* h = static_cast<ExecHandle*>(handle);
+  if (!h) return 0;
+  {
+    GIL gil;
+    PyObject* res = call_shim("exec_free", "(L)", h->hid);
+    if (res) Py_DECREF(res);
+    else PyErr_Clear();
+  }
+  delete h;
   return 0;
 }
 
