@@ -37,6 +37,12 @@ def main():
                     choices=["bfloat16", "float32"])
     ap.add_argument("--skip-attention", action="store_true",
                     help="omit the secondary flash-attention metric")
+    ap.add_argument("--skip-transformer", action="store_true",
+                    help="omit the model-level transformer-LM metric")
+    ap.add_argument("--lm-seq-len", type=int, default=4096)
+    ap.add_argument("--lm-hidden", type=int, default=2048)
+    ap.add_argument("--lm-layers", type=int, default=6)
+    ap.add_argument("--lm-batch", type=int, default=4)
     cli = ap.parse_args()
 
     import jax
@@ -102,8 +108,48 @@ def main():
         except Exception as e:
             print("flash-attention secondary bench failed: %r" % (e,),
                   file=sys.stderr)
+    if backend == "tpu" and not cli.skip_transformer:
+        # first-class MODEL-level metric: transformer-LM train step (seq 4k,
+        # bf16, Module fused path) — the framework-level MFU story, not
+        # just the attention kernel (examples/transformer/train_lm.py).
+        try:
+            lm = transformer_lm_bench(seq_len=cli.lm_seq_len,
+                                      hidden=cli.lm_hidden,
+                                      num_layers=cli.lm_layers,
+                                      batch_size=cli.lm_batch)
+            record["transformer_lm_tokens_per_sec"] = round(
+                lm["tokens_per_sec"], 1)
+            record["transformer_lm_tflops"] = round(lm["model_tflops"], 2)
+            record["transformer_lm_mfu"] = round(
+                lm["model_tflops"] * 1e12 / _peak_flops(backend), 4)
+        except Exception as e:
+            print("transformer-LM secondary bench failed: %r" % (e,),
+                  file=sys.stderr)
     print(json.dumps(record))
     return record
+
+
+def transformer_lm_bench(seq_len=4096, hidden=2048, num_layers=6,
+                         batch_size=4, num_steps=10, warmup=2):
+    """Model-level transformer-LM train-step benchmark through the Module
+    fused path (in-process; the TPU is held by this process)."""
+    import argparse as _ap
+
+    from examples.transformer import train_lm
+
+    args = train_lm.add_args(_ap.ArgumentParser()).parse_args([
+        "--benchmark", "1", "--seq-len", str(seq_len),
+        "--hidden", str(hidden), "--num-layers", str(num_layers),
+        "--num-heads", str(max(1, hidden // 128)),
+        "--batch-size", str(batch_size),
+        "--dtype", "bfloat16", "--optimizer", "adam",
+        "--num-steps", str(num_steps), "--warmup", str(warmup)])
+    import mxnet_tpu as mx
+
+    net = mx.models.get_transformer_lm(
+        vocab_size=args.vocab_size, num_layers=args.num_layers,
+        num_heads=args.num_heads, hidden=args.hidden, seq_len=args.seq_len)
+    return train_lm.benchmark(args, net)
 
 
 if __name__ == "__main__":

@@ -99,8 +99,10 @@ def benchmark(args, net):
     b, s, h, nh, l = (args.batch_size, args.seq_len, args.hidden,
                       args.num_heads, args.num_layers)
     v = args.vocab_size
-    # 6ND matmul flops + causal attention term, fwd+bwd
-    n_params = l * 12 * h * h + v * h * 2 + s * h
+    # 6ND matmul flops (N = block params + untied lm_head; the input
+    # embedding is a gather, not a matmul — counting it would inflate
+    # MFU) + the causal attention term, fwd+bwd
+    n_params = l * 12 * h * h + v * h
     flops = 6.0 * n_params * toks + l * args.num_steps * \
         (0.5 * 4 * b * nh * s * s * (h // nh)) * 3
     return {"tokens_per_sec": toks / dt, "step_time_ms": dt * 1e3 /

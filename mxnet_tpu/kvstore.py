@@ -19,6 +19,8 @@ from __future__ import annotations
 import pickle
 from typing import Callable, Dict, List, Optional, Union
 
+import numpy as np
+
 from .base import MXNetError
 from .ndarray import NDArray
 from . import ndarray as nd
@@ -172,13 +174,29 @@ class DistAsyncKVStore(KVStore):
         if host:
             port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
             self._server = None
+            # multi-server fleet: DMLC_SERVER_URIS ("h1:p1,h2:p2") when
+            # servers live on different hosts, else root_port+i on the
+            # root host (the launcher starts DMLC_NUM_SERVER of them)
+            uris = os.environ.get("DMLC_SERVER_URIS")
+            if uris:
+                addrs = [(h, int(p)) for h, p in
+                         (u.rsplit(":", 1) for u in uris.split(","))]
+            else:
+                n_srv = max(1, int(os.environ.get("DMLC_NUM_SERVER",
+                                                  "1") or "1"))
+                addrs = [(host, port + i) for i in range(n_srv)]
         else:
             # single-process bring-up: run the service in-process so the
             # async path works without a launcher
             self._server = kvs.start_server(
                 num_workers=int(os.environ.get("DMLC_NUM_WORKER", "1")))
-            host, port = self._server.addr
-        self._client = kvs.ServerClient(host, port)
+            addrs = [self._server.addr]
+        self._clients = [kvs.ServerClient(h, p) for h, p in addrs]
+        self._client = self._clients[0]
+        # reference kvstore_dist.h:264-302: arrays with at least this many
+        # elements are range-split evenly across the server fleet
+        self._bigarray_bound = int(
+            os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", str(1000 * 1000)))
         self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
         # liveness: periodic heartbeat so the server can report dead peers
@@ -196,13 +214,40 @@ class DistAsyncKVStore(KVStore):
     def num_workers(self) -> int:
         return self._num_workers
 
+    # -- key placement (reference kvstore_dist.h:264-302) -----------------
+    def _server_for(self, key):
+        """Stable small-key placement (crc32, NOT hash(): the builtin is
+        salted per process, so workers would disagree)."""
+        import zlib
+
+        return zlib.crc32(str(key).encode()) % len(self._clients)
+
+    def _ranges(self, n):
+        """Even contiguous [lo, hi) element ranges, one per server."""
+        ns = len(self._clients)
+        base, rem = divmod(n, ns)
+        bounds = [0]
+        for i in range(ns):
+            bounds.append(bounds[-1] + base + (1 if i < rem else 0))
+        return list(zip(bounds[:-1], bounds[1:]))
+
+    def _is_sharded(self, n_elements):
+        return (len(self._clients) > 1
+                and n_elements >= self._bigarray_bound)
+
     def init(self, key, value):
         keys, _ = _key_list(key)
         vals = _val_list(value, len(keys))
         for k, v in zip(keys, vals):
             if self._rank == 0:
-                arr = v[0].asnumpy() if isinstance(v[0], NDArray) else v[0]
-                self._client.init(k, arr)
+                arr = v[0].asnumpy() if isinstance(v[0], NDArray) else \
+                    np.asarray(v[0])
+                if self._is_sharded(arr.size):
+                    flat = arr.reshape(-1)
+                    for cid, (lo, hi) in enumerate(self._ranges(arr.size)):
+                        self._clients[cid].init(k, flat[lo:hi])
+                else:
+                    self._clients[self._server_for(k)].init(k, arr)
         self._client.barrier()
 
     def push(self, key, value, priority=0):
@@ -212,7 +257,18 @@ class DistAsyncKVStore(KVStore):
             merged = vlist[0].asnumpy()
             for v in vlist[1:]:
                 merged = merged + v.asnumpy()
-            self._client.push(k, merged, rank=self._rank)
+            if self._is_sharded(merged.size):
+                flat = merged.reshape(-1)
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(len(self._clients)) as pool:
+                    list(pool.map(
+                        lambda cr: self._clients[cr[0]].push(
+                            k, flat[cr[1][0]:cr[1][1]], rank=self._rank),
+                        enumerate(self._ranges(merged.size))))
+            else:
+                self._clients[self._server_for(k)].push(
+                    k, merged, rank=self._rank)
 
     def pull(self, key, out=None, priority=0):
         import jax
@@ -220,7 +276,21 @@ class DistAsyncKVStore(KVStore):
         keys, _ = _key_list(key)
         outs = _val_list(out, len(keys))
         for k, olist in zip(keys, outs):
-            arr = self._client.pull(k)
+            want = olist[0]
+            if self._is_sharded(int(np.prod(want.shape))):
+                # concurrent per-server pulls: latency is max-of-servers,
+                # not sum (the point of the range split; the reference's
+                # ps-lite worker overlaps its range requests the same way)
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(len(self._clients)) as pool:
+                    parts = list(pool.map(lambda c: c.pull(k),
+                                          self._clients))
+                arr = np.concatenate(
+                    [np.asarray(p).reshape(-1) for p in parts]
+                ).reshape(want.shape)
+            else:
+                arr = self._clients[self._server_for(k)].pull(k)
             for o in olist:
                 data = nd.array(arr, dtype=o.dtype)._data
                 # preserve the destination's sharding (see KVStore.pull)
@@ -241,9 +311,10 @@ class DistAsyncKVStore(KVStore):
             return 1
 
     def close(self):
-        """Tear down the client socket and any in-process server."""
+        """Tear down the client sockets and any in-process server."""
         try:
-            self._client.close()
+            for c in self._clients:
+                c.close()
         finally:
             if self._server is not None:
                 self._server.stop()
@@ -256,10 +327,11 @@ class DistAsyncKVStore(KVStore):
             pass
 
     def set_optimizer(self, optimizer):
-        """Ship the pickled optimizer to the server (reference
+        """Ship the pickled optimizer to every server (reference
         kvstore.py:232-255 _send_command_to_servers)."""
         if self._rank == 0:
-            self._client.set_optimizer(optimizer)
+            for c in self._clients:
+                c.set_optimizer(optimizer)
         self._client.barrier()
 
     def _barrier(self):
@@ -267,7 +339,8 @@ class DistAsyncKVStore(KVStore):
 
     def _send_command_to_servers(self, head, body):
         if head == "stop":
-            self._client.stop_server()
+            for c in self._clients:
+                c.stop_server()
 
     def save_optimizer_states(self, fname):
         raise MXNetError("Cannot save states for distributed training")

@@ -341,8 +341,20 @@ def _init_kvstore_server_module():
     role = os.environ.get("DMLC_ROLE", "")
     if role != "server":
         return
-    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    # Address resolution (clients derive the matching list in
+    # DistAsyncKVStore): DMLC_SERVER_URIS ("h1:p1,h2:p2", the ssh
+    # launcher's authoritative assignment) wins; otherwise server i
+    # listens on DMLC_PS_ROOT_URI : root_port + i.  Big arrays are
+    # range-split across the fleet (reference kvstore_dist.h:264-302).
+    server_id = int(os.environ.get("DMLC_SERVER_ID", "0"))
+    uris = os.environ.get("DMLC_SERVER_URIS")
+    if uris:
+        entry = uris.split(",")[server_id]
+        host, _, p = entry.rpartition(":")
+        port = int(p)
+    else:
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")) + server_id
     num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
     sync = os.environ.get("MXNET_KVSTORE_SYNC", "0") == "1"
     srv = KVStoreServer(host, port, num_workers, sync_mode=sync)

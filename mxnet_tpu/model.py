@@ -98,6 +98,25 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
+def find_latest_checkpoint(prefix):
+    """Newest saved epoch for ``prefix`` (prefix-%04d.params), or None.
+
+    The discovery half of checkpoint-based fault tolerance: a relaunched
+    worker resumes from here instead of a hand-passed --load-epoch
+    (reference mechanism: example/image-classification/common/fit.py
+    --load-epoch; the launcher's --auto-resume mode relies on this)."""
+    import glob
+    import re
+
+    best = None
+    for path in glob.glob("%s-[0-9][0-9][0-9][0-9].params" % prefix):
+        m = re.search(r"-(\d{4})\.params$", path)
+        if m:
+            ep = int(m.group(1))
+            best = ep if best is None else max(best, ep)
+    return best
+
+
 def load_checkpoint(prefix, epoch):
     """Load (symbol, arg_params, aux_params) from a checkpoint (reference
     model.py:349)."""

@@ -1,0 +1,74 @@
+"""Worker for the kill->relaunch->converge test (run via
+``tools/launch.py --auto-resume``).
+
+Attempt 0 trains with per-epoch checkpoints and dies hard (os._exit) after
+epoch 2 — a worker crash the launcher must notice. The relaunched attempt
+discovers the newest checkpoint with mx.model.find_latest_checkpoint,
+resumes from it (the reference's fit.py --load-epoch mechanism,
+example/image-classification/common/fit.py:119-128) and trains to
+completion, recording final train accuracy and the resumed epoch."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    workdir = sys.argv[1]
+    prefix = os.path.join(workdir, "ar")
+    attempt = int(os.environ.get("MXNET_AUTORESUME_ATTEMPT", "0"))
+    total_epochs = 10
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(120, 10).astype(np.float32)
+    w = rng.randn(4, 10).astype(np.float32)
+    y = (X @ w.T).argmax(1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=False)
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=32,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    arg_params = aux_params = None
+    begin_epoch = 0
+    latest = mx.model.find_latest_checkpoint(prefix)
+    if latest is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(prefix, latest)
+        begin_epoch = latest
+
+    callbacks = [mx.callback.do_checkpoint(prefix)]
+    if attempt == 0:
+        # die AFTER epoch 2's checkpoint is on disk, without cleanup
+        def crash(epoch, symbol, arg, aux):
+            if epoch + 1 >= 2:
+                os._exit(17)
+
+        callbacks.append(crash)
+
+    metric = mx.metric.Accuracy()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=total_epochs, begin_epoch=begin_epoch,
+            arg_params=arg_params, aux_params=aux_params,
+            optimizer="adam", optimizer_params={"learning_rate": 0.05},
+            eval_metric=metric, epoch_end_callback=callbacks)
+
+    it.reset()
+    metric.reset()
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        mod.update_metric(metric, batch.label)
+    with open(os.path.join(workdir, "result.json"), "w") as f:
+        json.dump({"acc": metric.get()[1], "resumed_from": begin_epoch,
+                   "attempt": attempt}, f)
+
+
+if __name__ == "__main__":
+    main()

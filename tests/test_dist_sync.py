@@ -70,3 +70,88 @@ def test_dist_fused_global_mesh_4_workers():
     assert res.returncode == 0, (res.stdout[-3000:], res.stderr[-3000:])
     assert _ok_ranks(res.stdout, "dist_fused_worker") == {0, 1, 2, 3}, \
         res.stdout
+
+
+def test_ssh_launcher_mode(tmp_path):
+    """--launcher ssh: one process per hostfile entry via ssh, env inlined
+    into the remote command (reference tools/launch.py ssh mode). sshd is
+    unavailable in CI, so a stub `ssh` on PATH captures the wire command
+    and executes the remote part locally — validating host assignment,
+    the DMLC env contract, and quoting end to end."""
+    stub_dir = tmp_path / "bin"
+    stub_dir.mkdir()
+    log = tmp_path / "ssh_calls.log"
+    stub = stub_dir / "ssh"
+    # stub contract: ssh -o X -p PORT HOST REMOTE_CMD -> run REMOTE_CMD
+    stub.write_text(
+        "#!/bin/bash\n"
+        "shift 2  # -o StrictHostKeyChecking=no\n"
+        "shift 2  # -p PORT\n"
+        "host=$1; shift\n"
+        "echo \"$host\" >> %s\n"
+        "exec bash -c \"$1\"\n" % log)
+    stub.chmod(0o755)
+
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("hostA\nhostB\n# a comment\n")
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    worker = tmp_path / "worker.sh"
+    worker.write_text(
+        "#!/bin/bash\n"
+        "echo \"$DMLC_ROLE $DMLC_WORKER_ID $DMLC_NUM_WORKER "
+        "$DMLC_PS_ROOT_PORT\" > %s/w$DMLC_WORKER_ID\n" % outdir)
+    worker.chmod(0o755)
+
+    env = dict(os.environ)
+    env["PATH"] = "%s:%s" % (stub_dir, env["PATH"])
+    env.pop("DMLC_ROLE", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "--launcher", "ssh", "--hostfile", str(hostfile),
+         "-n", "4", "bash", str(worker)],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+
+    # workers round-robin over the two hosts
+    calls = log.read_text().split()
+    assert sorted(calls) == ["hostA", "hostA", "hostB", "hostB"]
+    # every worker got a distinct id and the same rendezvous contract
+    seen = {}
+    for i in range(4):
+        role, wid, nw, port = (outdir / ("w%d" % i)).read_text().split()
+        assert role == "worker" and int(wid) == i and nw == "4"
+        seen.setdefault("port", port)
+        assert port == seen["port"]
+
+
+def test_auto_resume_kill_relaunch_converge(tmp_path):
+    """Checkpoint-based fault tolerance end to end: the worker dies hard
+    (os._exit 17) after epoch 2; launch.py --auto-resume relaunches it;
+    the relaunch resumes from the newest checkpoint via
+    mx.model.find_latest_checkpoint and converges (reference mechanism:
+    fit.py --load-epoch, example/image-classification/common/fit.py)."""
+    import json
+
+    env = dict(os.environ)
+    env.pop("DMLC_ROLE", None)
+    env["XLA_FLAGS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "1", "--auto-resume", "2",
+         sys.executable, os.path.join(ROOT, "tests", "autoresume_worker.py"),
+         str(tmp_path)],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    assert "relaunch 1/2" in res.stderr
+
+    with open(tmp_path / "result.json") as f:
+        result = json.load(f)
+    # the surviving attempt resumed from the crash-epoch checkpoint
+    assert result["attempt"] == 1
+    assert result["resumed_from"] == 2
+    assert result["acc"] > 0.9, result
+    # checkpoints for both attempts' epochs exist (2 from attempt 0)
+    import mxnet_tpu as mx
+    assert mx.model.find_latest_checkpoint(str(tmp_path / "ar")) == 10

@@ -192,3 +192,57 @@ def test_server_role_bootstrap_subprocess():
     finally:
         if server.poll() is None:
             server.kill()
+
+
+def test_dist_async_bigarray_range_split(monkeypatch):
+    """Arrays >= MXNET_KVSTORE_BIGARRAY_BOUND elements are range-split
+    across the server fleet (reference kvstore_dist.h:264-302): each
+    server holds only its contiguous slice, and init/push/pull round-trip
+    the full array; small keys stay whole on one crc32-assigned server."""
+    s0 = kvs.start_server(num_workers=1)
+    s1 = kvs.start_server(num_workers=1)
+    try:
+        host, p0 = s0.addr
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", host)
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(p0))
+        monkeypatch.setenv("DMLC_SERVER_URIS",
+                           "%s:%d,%s:%d" % (host, p0, host, s1.addr[1]))
+        monkeypatch.setenv("DMLC_NUM_SERVER", "2")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "100")
+
+        kv = mx.kvstore.create("dist_async")
+        try:
+            big = np.arange(250, dtype=np.float32).reshape(5, 50)
+            kv.init("big", mx.nd.array(big))
+            # each server holds only its contiguous range
+            assert s0.store["big"].size == 125
+            assert s1.store["big"].size == 125
+            assert_almost_equal(
+                np.concatenate([s0.store["big"], s1.store["big"]]),
+                big.reshape(-1))
+
+            kv.push("big", mx.nd.array(np.ones((5, 50), np.float32)))
+            out = mx.nd.zeros((5, 50))
+            kv.pull("big", out=out)
+            assert_almost_equal(out.asnumpy(), big + 1.0)
+
+            # under the bound: whole array on exactly one server
+            small = np.ones((4,), np.float32)
+            kv.init("small", mx.nd.array(small))
+            holders = [s for s in (s0, s1) if "small" in s.store]
+            assert len(holders) == 1
+            out_s = mx.nd.zeros((4,))
+            kv.pull("small", out=out_s)
+            assert_almost_equal(out_s.asnumpy(), small)
+
+            # server-side optimizer applies per slice (elementwise update)
+            kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+            kv.push("big", mx.nd.array(np.full((5, 50), 2.0, np.float32)))
+            kv.pull("big", out=out)
+            assert_almost_equal(out.asnumpy(), big + 1.0 - 0.5 * 2.0)
+        finally:
+            kv.close()
+    finally:
+        s0.stop()
+        s1.stop()

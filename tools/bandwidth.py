@@ -23,12 +23,16 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes-mb", default="1,4,16,64")
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--kv-store", default="dist_sync",
+                    choices=["dist_sync", "dist_async"],
+                    help="dist_async measures the TCP parameter-server "
+                         "push+pull path (launch with -s servers)")
     cli = ap.parse_args()
 
     import numpy as np
     import mxnet_tpu as mx
 
-    kv = mx.kvstore.create("dist_sync")
+    kv = mx.kvstore.create(cli.kv_store)
     rank, n = kv.rank, kv.num_workers
 
     for i, size_mb in enumerate(float(s) for s in cli.sizes_mb.split(",")):
@@ -48,12 +52,19 @@ def main():
         out.asnumpy()
         dt = (time.time() - t0) / cli.iters
         expect = (n * (n + 1)) // 2  # sum of (rank+1): init 0 + iters pushes
-        bus_bw = 2 * (n - 1) / n * size_mb * 1e6 / dt
+        if cli.kv_store == "dist_sync":
+            # standard allreduce bus accounting
+            bw = 2 * (n - 1) / n * size_mb * 1e6 / dt
+            metric = "allreduce_bandwidth"
+        else:
+            # parameter-server path: bytes pushed per timed iteration
+            bw = size_mb * 1e6 / dt
+            metric = "ps_push_bandwidth"
         if rank == 0:
             print(json.dumps({
-                "metric": "allreduce_bandwidth", "size_mb": size_mb,
+                "metric": metric, "size_mb": size_mb,
                 "workers": n, "time_ms": round(dt * 1e3, 3),
-                "bus_gb_s": round(bus_bw / 1e9, 3),
+                "bus_gb_s": round(bw / 1e9, 3),
                 "unit": "GB/s"}), flush=True)
     if rank == 0:
         print("bandwidth OK", flush=True)

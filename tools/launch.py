@@ -9,14 +9,18 @@ mxnet_tpu/kvstore_server.py takes over in those).  For ``dist_sync`` no
 servers are needed — workers rendezvous through the jax.distributed
 coordinator at DMLC_PS_ROOT_URI:DMLC_PS_ROOT_PORT (kvstore_dist.py).
 
-Only the ``local`` launcher is implemented: on TPU pods the platform
-scheduler (GKE/XPK) starts one process per host with the same env contract,
-so ssh/mpi/sge/yarn modes of the reference are intentionally out of scope.
+Launchers: ``local`` (processes on this host) and ``ssh`` (one process
+per entry of ``--hostfile``, reference tools/launch.py ssh mode — the mode
+that maps to TPU-VM fleets, which are plain Linux hosts).  The reference's
+mpi/sge/yarn modes are intentionally out of scope: XLA collectives replace
+MPI, and pod slices are provisioned by the cloud control plane, not a
+Hadoop-era batch queue (see docs/how_to/deviations.md).
 """
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import signal
 import socket
 import subprocess
@@ -31,6 +35,38 @@ def _free_port():
     return port
 
 
+def _local_ip():
+    """A routable address for DMLC_PS_ROOT_URI in ssh mode (the UDP-connect
+    trick; no packet is sent)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 53))
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
+def _ssh_popen(host, env, command, ssh_port, cwd, extra_keys=()):
+    """One remote process: env inlined into the remote command line (ssh
+    does not forward the environment), cwd mirrored (the reference's ssh
+    tracker does the same 'cd <pwd> && env ... cmd').  extra_keys carries
+    the --env entries so 'every process' includes remote ones."""
+    pass_keys = [k for k in env
+                 if k.startswith(("DMLC_", "MXNET_"))
+                 or k in ("PYTHONPATH", "JAX_PLATFORMS")
+                 or k in extra_keys]
+    env_str = " ".join("%s=%s" % (k, shlex.quote(env[k]))
+                       for k in sorted(set(pass_keys)))
+    remote = "cd %s && env %s %s" % (
+        shlex.quote(cwd), env_str,
+        " ".join(shlex.quote(c) for c in command))
+    return subprocess.Popen(
+        ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(ssh_port),
+         host, remote])
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="Launch a distributed job locally",
@@ -38,43 +74,107 @@ def main():
     parser.add_argument("-n", "--num-workers", type=int, required=True)
     parser.add_argument("-s", "--num-servers", type=int, default=0)
     parser.add_argument("--launcher", type=str, default="local",
-                        choices=["local"])
+                        choices=["local", "ssh"])
+    parser.add_argument("-H", "--hostfile", type=str, default=None,
+                        help="one host per line (ssh launcher); workers "
+                             "and servers round-robin over the hosts")
+    parser.add_argument("--ssh-port", type=int, default=22)
     parser.add_argument("--env", action="append", default=[],
                         help="extra KEY=VALUE env entries for every process")
+    parser.add_argument("--auto-resume", type=int, default=0, metavar="N",
+                        help="relaunch a worker that exits nonzero, up to N "
+                             "times per worker (checkpoint-based fault "
+                             "tolerance: the training script resumes via "
+                             "mx.model.find_latest_checkpoint)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.command:
         parser.error("no command given")
 
+    hosts = None
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            parser.error("--launcher ssh requires --hostfile")
+        with open(args.hostfile) as f:
+            stripped = [ln.strip() for ln in f]
+        hosts = [ln for ln in stripped if ln and not ln.startswith("#")]
+        if not hosts:
+            parser.error("hostfile %s is empty" % args.hostfile)
+
+    default_uri = _local_ip() if args.launcher == "ssh" else "127.0.0.1"
     port = os.environ.get("DMLC_PS_ROOT_PORT") or str(_free_port())
     base_env = dict(os.environ)
     base_env.update({
-        "DMLC_PS_ROOT_URI": os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+        "DMLC_PS_ROOT_URI": os.environ.get("DMLC_PS_ROOT_URI", default_uri),
         "DMLC_PS_ROOT_PORT": port,
         "DMLC_NUM_WORKER": str(args.num_workers),
         "DMLC_NUM_SERVER": str(args.num_servers),
     })
+    if hosts is not None and args.num_servers > 0:
+        # ssh mode places server i on hosts[i % len]; workers cannot derive
+        # that from root_uri+port alone, so publish the authoritative
+        # address list (server i binds, clients connect, from this)
+        base_env["DMLC_SERVER_URIS"] = ",".join(
+            "%s:%d" % (hosts[i % len(hosts)], int(port) + i)
+            for i in range(args.num_servers))
+    extra_keys = tuple(kv.partition("=")[0] for kv in args.env)
     for kv in args.env:
         k, _, v = kv.partition("=")
         base_env[k] = v
 
+    def spawn(env, rank):
+        if hosts is None:
+            return subprocess.Popen(args.command, env=env)
+        return _ssh_popen(hosts[rank % len(hosts)], env, args.command,
+                          args.ssh_port, os.getcwd(), extra_keys)
+
     procs = []
     server_procs = []
+    worker_envs = []
     try:
         for i in range(args.num_servers):
             env = dict(base_env)
             env["DMLC_ROLE"] = "server"
             env["DMLC_SERVER_ID"] = str(i)
-            server_procs.append(subprocess.Popen(args.command, env=env))
+            server_procs.append(spawn(env, i))
         for i in range(args.num_workers):
             env = dict(base_env)
             env["DMLC_ROLE"] = "worker"
             env["DMLC_WORKER_ID"] = str(i)
-            procs.append(subprocess.Popen(args.command, env=env))
+            worker_envs.append(env)
+            procs.append(spawn(env, i))
         rc = 0
-        for p in procs:
-            p.wait()
-            rc = rc or p.returncode
+        if args.auto_resume:
+            # supervise: a crashed worker comes back (its script resumes
+            # from the newest checkpoint); clean exits retire normally
+            import time
+
+            attempts = [0] * args.num_workers
+            live = dict(enumerate(procs))
+            while live:
+                time.sleep(0.2)
+                for i, p in list(live.items()):
+                    r = p.poll()
+                    if r is None:
+                        continue
+                    if r != 0 and attempts[i] < args.auto_resume:
+                        attempts[i] += 1
+                        env = dict(worker_envs[i])
+                        env["MXNET_AUTORESUME_ATTEMPT"] = str(attempts[i])
+                        print("launch.py: worker %d exited rc=%d; "
+                              "relaunch %d/%d" % (i, r, attempts[i],
+                                                  args.auto_resume),
+                              file=sys.stderr, flush=True)
+                        p2 = spawn(env, i)
+                        live[i] = p2
+                        procs.append(p2)
+                    else:
+                        rc = rc or r
+                        del live[i]
+        else:
+            for p in procs:
+                p.wait()
+                rc = rc or p.returncode
     finally:
         for p in procs + server_procs:
             if p.poll() is None:
