@@ -199,6 +199,7 @@ class DistAsyncKVStore(KVStore):
             os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", str(1000 * 1000)))
         self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        self._pool = None  # lazy; lives for the store's lifetime
         # liveness: periodic heartbeat so the server can report dead peers
         # and release stuck barriers (kvstore_dist.h:151-160 parity)
         self._client.start_heartbeat(
@@ -235,6 +236,16 @@ class DistAsyncKVStore(KVStore):
         return (len(self._clients) > 1
                 and n_elements >= self._bigarray_bound)
 
+    def _client_pool(self):
+        """One long-lived thread pool for concurrent per-server RPCs —
+        push/pull run every step; spawning threads per call would sit on
+        the training hot path."""
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(len(self._clients))
+        return self._pool
+
     def init(self, key, value):
         keys, _ = _key_list(key)
         vals = _val_list(value, len(keys))
@@ -259,13 +270,10 @@ class DistAsyncKVStore(KVStore):
                 merged = merged + v.asnumpy()
             if self._is_sharded(merged.size):
                 flat = merged.reshape(-1)
-                from concurrent.futures import ThreadPoolExecutor
-
-                with ThreadPoolExecutor(len(self._clients)) as pool:
-                    list(pool.map(
-                        lambda cr: self._clients[cr[0]].push(
-                            k, flat[cr[1][0]:cr[1][1]], rank=self._rank),
-                        enumerate(self._ranges(merged.size))))
+                list(self._client_pool().map(
+                    lambda cr: self._clients[cr[0]].push(
+                        k, flat[cr[1][0]:cr[1][1]], rank=self._rank),
+                    enumerate(self._ranges(merged.size))))
             else:
                 self._clients[self._server_for(k)].push(
                     k, merged, rank=self._rank)
@@ -281,11 +289,8 @@ class DistAsyncKVStore(KVStore):
                 # concurrent per-server pulls: latency is max-of-servers,
                 # not sum (the point of the range split; the reference's
                 # ps-lite worker overlaps its range requests the same way)
-                from concurrent.futures import ThreadPoolExecutor
-
-                with ThreadPoolExecutor(len(self._clients)) as pool:
-                    parts = list(pool.map(lambda c: c.pull(k),
-                                          self._clients))
+                parts = list(self._client_pool().map(
+                    lambda c: c.pull(k), self._clients))
                 arr = np.concatenate(
                     [np.asarray(p).reshape(-1) for p in parts]
                 ).reshape(want.shape)
@@ -313,6 +318,9 @@ class DistAsyncKVStore(KVStore):
     def close(self):
         """Tear down the client sockets and any in-process server."""
         try:
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+                self._pool = None
             for c in self._clients:
                 c.close()
         finally:

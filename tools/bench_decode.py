@@ -1,0 +1,73 @@
+"""JPEG decode-scaling microbenchmark for the native IO path.
+
+Measures img/s through mxnet_tpu.native.decode_jpeg_batch (the GIL-free
+C++ thread-pool decoder, src/imgdecode.cc) at 224x224 across thread
+counts — the feed-the-chip half of the benchmark story (reference:
+example/image-classification/README.md:245-268 'Note on CPU decoding
+performance').
+
+Prints one JSON line per thread count:
+  {"metric": "jpeg_decode_img_per_sec", "nthreads": N, "value": ...}
+
+Used by tests/test_real_data_e2e.py to enforce the per-core decode floor.
+"""
+import argparse
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_jpegs(n=64, size=224, quality=90):
+    """Deterministic photographic-ish JPEGs (noise compresses atypically)."""
+    import numpy as np
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    bufs = []
+    base = rng.randint(0, 255, size=(size, size, 3), dtype=np.uint8)
+    for i in range(n):
+        # smooth gradients + a shifted noise field: realistic JPEG entropy
+        arr = np.roll(base, i * 7, axis=1)
+        yy = np.linspace(0, 255, size, dtype=np.uint8)
+        arr = (arr // 2 + yy[None, :, None] // 2).astype(np.uint8)
+        b = io.BytesIO()
+        Image.fromarray(arr).save(b, format="JPEG", quality=quality)
+        bufs.append(b.getvalue())
+    return bufs
+
+
+def run(nthreads, n_images=256, size=224, iters=3):
+    from mxnet_tpu import native
+
+    bufs = make_jpegs(min(n_images, 64), size=size)
+    bufs = (bufs * ((n_images + len(bufs) - 1) // len(bufs)))[:n_images]
+    # warm up (thread pool spawn, lazy lib load)
+    out = native.decode_jpeg_batch(bufs[:8], nthreads=nthreads)
+    if out[0] is None:
+        raise RuntimeError("native decoder unavailable (libmxtpu.so)")
+    t0 = time.time()
+    for _ in range(iters):
+        native.decode_jpeg_batch(bufs, nthreads=nthreads)
+    dt = time.time() - t0
+    return n_images * iters / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--threads", default="1,2,4")
+    ap.add_argument("--n-images", type=int, default=256)
+    ap.add_argument("--size", type=int, default=224)
+    cli = ap.parse_args()
+    for nt in (int(t) for t in cli.threads.split(",")):
+        rate = run(nt, n_images=cli.n_images, size=cli.size)
+        print(json.dumps({"metric": "jpeg_decode_img_per_sec",
+                          "nthreads": nt, "size": cli.size,
+                          "value": round(rate, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

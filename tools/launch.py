@@ -101,7 +101,10 @@ def main():
         if not hosts:
             parser.error("hostfile %s is empty" % args.hostfile)
 
-    default_uri = _local_ip() if args.launcher == "ssh" else "127.0.0.1"
+    # ssh mode: the rendezvous endpoint (jax.distributed coordinator) is
+    # hosted by worker 0, which lands on the FIRST hostfile entry — the
+    # launcher machine itself may not run any process at all
+    default_uri = hosts[0] if hosts else "127.0.0.1"
     port = os.environ.get("DMLC_PS_ROOT_PORT") or str(_free_port())
     base_env = dict(os.environ)
     base_env.update({
