@@ -29,6 +29,13 @@ import functools
 import numpy as np
 
 _NEG = -1e30
+# exp2-based softmax: fold log2(e) into the QK scale so the kernel's
+# exponentials are exp2 (the VPU's native transcendental; jnp.exp lowers
+# to exp2(x*log2e) anyway — folding removes that multiply from the
+# bq*bk-element hot loop). The lse written at the boundary stays NATURAL
+# log (the ring/backward contract).
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
 
 
 def _reference_attention(q, k, v, causal, scale):
@@ -85,20 +92,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     @pl.when(live)
     def _compute():
         # dots stay in the input dtype (bf16 on TPU -> MXU) with f32
-        # accumulation; only the softmax state is f32
+        # accumulation; only the softmax state is f32. Scores live in the
+        # base-2 domain (scale folded with log2e — see _LOG2E note).
         q = q_ref[0]
         kblk = k_ref[0]
         vblk = v_ref[0]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) \
+            * (scale * _LOG2E)
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG)
         m = m_scr[...]
         m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
+        p = jnp.exp2(s - m_new[:, None])
+        corr = jnp.exp2(m - m_new)
         l_scr[...] = l_scr[...] * corr + p.sum(axis=-1)
         acc_scr[...] = acc_scr[...] * corr[:, None] + jax.lax.dot_general(
             p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
@@ -110,7 +119,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = l_scr[...]
         lsafe = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_scr[...] / lsafe[:, None]).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_scr[...] + jnp.log(lsafe)
+        # back to natural log at the boundary (ring/backward contract)
+        lse_ref[0, 0] = (m_scr[...] + jnp.log2(lsafe)) * _LN2
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -160,6 +170,11 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((bq,), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
+        # bh and q-tile iterations are independent (parallel); the k
+        # stream is the sequential dim carrying the softmax state — the
+        # semantics let Mosaic overlap the K/V block DMAs with compute
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt)
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse.reshape(b * h, sq)
@@ -194,12 +209,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) \
+            * (scale * _LOG2E)
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG)
-        p = jnp.exp(s - lse[:, None])
+        # p is the same probability either way; only the exponential's
+        # base changes (s and lse both carried in the base-2 domain)
+        p = jnp.exp2(s - (lse * _LOG2E)[:, None])
         dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta[:, None]) * scale).astype(kblk.dtype)
@@ -238,12 +256,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32) \
+            * (scale * _LOG2E)
         if causal:
             q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG)
-        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        p = jnp.exp2(s - (lse * _LOG2E)[:, None])  # [bq, bk]
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bk, d]
@@ -322,6 +341,8 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=sds((b * h, sq, d), q.dtype),
         scratch_shapes=[scratch((bq, d))],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt, dot, lse3, delta3)
 
@@ -344,6 +365,8 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         out_shape=[sds((b * h, sk, d), k.dtype),
                    sds((b * h, sk, d), v.dtype)],
         scratch_shapes=[scratch((bk, d)), scratch((bk, d))],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt, dot, lse3, delta3)
 

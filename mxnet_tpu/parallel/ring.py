@@ -222,9 +222,11 @@ def _ring_flash_bwd(q, k, v, o, lse, do, *, axis, vary_axes, n_shards,
         def go_skip(_):
             # zeros_like tracks the compute branches' shape AND dtype
             # (dq/dk/dv come back in q/k/v dtype; lax.switch requires
-            # identical branch signatures for mixed-precision q vs k/v)
-            return (_vary(jnp.zeros_like(q)), _vary(jnp.zeros_like(k)),
-                    _vary(jnp.zeros_like(v)))
+            # identical branch signatures for mixed-precision q vs k/v).
+            # No _vary: zeros_like inherits the operand's varying type,
+            # and pcast varying->varying is rejected.
+            return (jnp.zeros_like(q), jnp.zeros_like(k),
+                    jnp.zeros_like(v))
 
         if causal:
             branch = jnp.where(k_idx == idx, 0,

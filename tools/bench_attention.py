@@ -68,6 +68,53 @@ def run_bench(batch=1, heads=8, head_dim=128, seq=16384, steps=10,
         "backend": jax.default_backend()}
 
 
+def run_oracle_bench(batch=1, heads=8, head_dim=128, seq=16384, steps=10):
+    """Same train-step timing through jax.experimental.pallas.ops.tpu
+    splash attention — the mature upstream TPU kernel, benchmarked as the
+    ceiling our kernel is chasing (TPU only; raises elsewhere)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as splash,
+        splash_attention_mask as mask_lib,
+    )
+
+    if jax.default_backend() != "tpu":
+        raise RuntimeError("splash attention oracle needs a TPU")
+    b, h, d, s = batch, heads, head_dim, seq
+    key = jax.random.PRNGKey(0)
+    # splash layout is [heads, seq, d] per batch entry (vmap over batch)
+    q = jax.random.normal(key, (b, h, s, d), jnp.bfloat16) * 0.1
+    k = jax.random.normal(key, (b, h, s, d), jnp.bfloat16) * 0.1
+    v = jax.random.normal(key, (b, h, s, d), jnp.bfloat16) * 0.1
+    mask = mask_lib.MultiHeadMask(
+        [mask_lib.CausalMask((s, s)) for _ in range(h)])
+    kernel = splash.make_splash_mha_single_device(mask=mask)
+
+    def loss(q, k, v):
+        o = jax.vmap(kernel)(q, k, v)
+        return jnp.mean(o.astype(jnp.float32) ** 2)
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    chain = jax.jit(lambda q, dq: q + 0 * dq)
+    g = step(q, k, v)
+    q = chain(q, g[0])
+    np.asarray(q[0, 0, 0, 0])
+    t0 = time.time()
+    for _ in range(steps):
+        g = step(q, k, v)
+        q = chain(q, g[0])
+    np.asarray(q[0, 0, 0, 0])
+    dt_s = (time.time() - t0) / steps
+    total = 3.0 * 0.5 * 4.0 * b * h * s * s * d
+    return {"metric": "splash_attention_oracle_tflops",
+            "value": round(total / dt_s / 1e12, 2), "unit": "TFLOP/s",
+            "seq": s, "step_ms": round(dt_s * 1e3, 2),
+            "mfu": round(total / dt_s / 197e12, 4)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=1)
@@ -77,11 +124,18 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--block-q", type=int, default=512)
     ap.add_argument("--block-k", type=int, default=1024)
+    ap.add_argument("--oracle", action="store_true",
+                    help="also time upstream splash attention (the "
+                         "ceiling reference)")
     cli = ap.parse_args()
     print(json.dumps(run_bench(
         batch=cli.batch, heads=cli.heads, head_dim=cli.head_dim,
         seq=cli.seq, steps=cli.steps, block_q=cli.block_q,
         block_k=cli.block_k)))
+    if cli.oracle:
+        print(json.dumps(run_oracle_bench(
+            batch=cli.batch, heads=cli.heads, head_dim=cli.head_dim,
+            seq=cli.seq, steps=cli.steps)))
 
 
 if __name__ == "__main__":

@@ -103,8 +103,9 @@ def main():
 
     # ssh mode: the rendezvous endpoint (jax.distributed coordinator) is
     # hosted by worker 0, which lands on the FIRST hostfile entry — the
-    # launcher machine itself may not run any process at all
-    default_uri = hosts[0] if hosts else "127.0.0.1"
+    # launcher machine itself may not run any process at all. Strip any
+    # user@ login prefix: ssh accepts it, coordinator_address cannot.
+    default_uri = hosts[0].rsplit("@", 1)[-1] if hosts else "127.0.0.1"
     port = os.environ.get("DMLC_PS_ROOT_PORT") or str(_free_port())
     base_env = dict(os.environ)
     base_env.update({
