@@ -48,6 +48,44 @@ def build_module(batch):
     return mod, train
 
 
+def bn_fusion_analysis(hlo_text):
+    """Does BN's scale/shift ride the conv epilogue? (VERDICT r4 ask.)
+
+    Classifies every convolution by actual dataflow, not substring
+    presence: a conv counts as epilogue-fused only when its RESULT name
+    is an operand of a multiply/add/subtract inside the same non-entry
+    fusion computation (the BN affine transform then costs no extra HBM
+    round trip). Convs in the ENTRY computation are bare by definition —
+    entry-level instructions are separate kernels even when an
+    elementwise op consumes them there (worth ~2 MFU points per PERF.md's
+    control-minus-BN-stats data if that is where BN's scale/shift run)."""
+    # computations: optional ENTRY prefix, then '%name (...) -> ... {'
+    blocks = re.findall(r"^(ENTRY\s+)?%?[\w.-]+ [^\n]*\{\n(.*?)^\s*\}",
+                        hlo_text, re.M | re.S)
+    fused = fused_plain = bare = 0
+    for entry_prefix, body in blocks:
+        conv_names = [m.group(1) for m in re.finditer(
+            r"(%[\w.-]+)\s*=\s*\S+\s+convolution\(", body)]
+        if not conv_names:
+            continue
+        if entry_prefix:
+            bare += len(conv_names)
+            continue
+        ew_operands = set()
+        for m in re.finditer(
+                r"=\s*\S+\s+(?:multiply|add|subtract)\(([^)]*)\)", body):
+            ew_operands.update(re.findall(r"%[\w.-]+", m.group(1)))
+        for c in conv_names:
+            if c in ew_operands:
+                fused += 1
+            else:
+                fused_plain += 1
+    return {"convs_total": fused + fused_plain + bare,
+            "convs_fused_with_elementwise_epilogue": fused,
+            "convs_fused_plain": fused_plain,
+            "convs_bare_in_entry": bare}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=256)
@@ -98,6 +136,7 @@ def main():
         convs = re.findall(r"= (\S+) convolution\(", hlo)
         report["conv_result_dtypes"] = dict(collections.Counter(
             c.split("[")[0] for c in convs))
+        report["bn_fusion"] = bn_fusion_analysis(hlo)
         if cli.dump_hlo:
             with open(cli.dump_hlo, "w") as f:
                 f.write(hlo)
