@@ -90,6 +90,31 @@ int MXTPUImperativeInvoke(const char* op_name, int num_inputs,
                           const char** param_keys, const char** param_vals);
 
 /* ------------------------------------------------------------------ */
+/* DataIter surface — drive the file-backed input pipeline from C.
+ * Reference analogue: c_api.cc:446-543 (MXListDataIters,
+ * MXDataIterCreateIter/Next/GetData/GetLabel/GetPadNum/BeforeFirst).
+ * Attr values are strings parsed like Python literals: batch_size="8",
+ * data_shape="(3, 64, 64)", path_imgrec="train.rec". */
+
+typedef void* DataIterHandle;
+
+/* Creatable iterator names (thread-local storage). */
+int MXTPUListDataIters(mx_uint* out_size, const char*** out_array);
+int MXTPUDataIterCreate(const char* name, mx_uint num_params,
+                        const char** keys, const char** vals,
+                        DataIterHandle* out);
+/* *out = 1 while a batch is available, 0 at end of epoch. */
+int MXTPUDataIterNext(DataIterHandle handle, int* out);
+int MXTPUDataIterBeforeFirst(DataIterHandle handle);
+/* Current batch tensors; each returned handle is caller-owned
+ * (MXTPUNDArrayFree). */
+int MXTPUDataIterGetData(DataIterHandle handle, NDArrayHandle* out);
+int MXTPUDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out);
+/* Zero-padded tail rows in the current batch. */
+int MXTPUDataIterGetPadNum(DataIterHandle handle, int* out);
+int MXTPUDataIterFree(DataIterHandle handle);
+
+/* ------------------------------------------------------------------ */
 /* Symbol surface — build/inspect graphs from C with no Python setup.
  * Reference analogue: c_api_symbolic.cc:54-545 (MXSymbolCreateFromJSON,
  * MXSymbolListArguments/Outputs/AuxiliaryStates, MXSymbolInferShape). */

@@ -323,3 +323,98 @@ def exec_outputs(hid):
 
 def exec_free(hid):
     _executors.pop(hid)
+
+
+# ---------------------------------------------------------------------------
+# DataIter C API backing (src/c_api.cc — the reference's
+# c_api.cc:446-543 MXListDataIters/MXDataIterCreateIter/Next/GetData/
+# GetLabel/GetPadNum/BeforeFirst/Free).  String attrs are parsed with
+# literal_eval (the reference's param-spec string parsing), so a C
+# consumer writes batch_size="8", data_shape="(3, 64, 64)".
+# ---------------------------------------------------------------------------
+
+_dataiters = _HandleRegistry()
+
+# iterators creatable from string params alone (file-backed; the
+# array-backed NDArrayIter needs live buffers and stays Python-only,
+# matching the reference where it is a Python-side class too)
+_C_ITER_NAMES = ("MNISTIter", "CSVIter", "ImageRecordIter",
+                 "ImageDetRecordIter")
+
+
+def iter_list():
+    return list(_C_ITER_NAMES)
+
+
+# params that are strings by contract: a shard file named '123' must not
+# become the int 123 (the reference parses against typed param specs)
+_STR_ATTRS = frozenset((
+    "data_csv", "label_csv", "image", "label", "path_imgrec",
+    "path_imglist", "path_imgidx", "path_root", "mean_img", "data_name",
+    "label_name"))
+
+
+def _parse_attr(k, v):
+    import ast
+
+    if k in _STR_ATTRS:
+        return v
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def iter_create(name, keys, vals):
+    from . import image as image_mod
+    from . import io as io_mod
+
+    if name not in _C_ITER_NAMES:
+        raise ValueError("unknown data iterator %r (have: %s)"
+                         % (name, ", ".join(_C_ITER_NAMES)))
+    cls = getattr(io_mod, name, None) or getattr(image_mod, name)
+    kwargs = {k: _parse_attr(k, v) for k, v in zip(keys, vals)}
+    return _dataiters.put({"iter": cls(**kwargs), "batch": None})
+
+
+def _iter_get(hid):
+    return _dataiters.get(hid, "DataIter")
+
+
+def iter_next(hid):
+    rec = _iter_get(hid)
+    try:
+        rec["batch"] = rec["iter"].next()
+        return 1
+    except StopIteration:
+        rec["batch"] = None
+        return 0
+
+
+def iter_before_first(hid):
+    rec = _iter_get(hid)
+    rec["batch"] = None
+    rec["iter"].reset()
+
+
+def _iter_batch(hid):
+    batch = _iter_get(hid)["batch"]
+    if batch is None:
+        raise RuntimeError("no current batch: call DataIterNext first")
+    return batch
+
+
+def iter_get_data(hid):
+    return _nd_put(_iter_batch(hid).data[0])
+
+
+def iter_get_label(hid):
+    return _nd_put(_iter_batch(hid).label[0])
+
+
+def iter_get_pad(hid):
+    return int(_iter_batch(hid).pad or 0)
+
+
+def iter_free(hid):
+    _dataiters.pop(hid)

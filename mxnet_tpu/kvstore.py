@@ -199,6 +199,14 @@ class DistAsyncKVStore(KVStore):
             os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", str(1000 * 1000)))
         self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        # rejoin semantics (reference kvstore_dist.h:35-38 IsRecovery):
+        # a relaunched worker must NOT wait at startup barriers — its
+        # peers are mid-training and will never arrive. Server state is
+        # safe: init is setdefault on the server, so re-init cannot
+        # clobber trained weights; the worker pulls current ones.
+        self._is_recovery = (
+            os.environ.get("DMLC_IS_RECOVERY", "") == "1"
+            or int(os.environ.get("MXNET_AUTORESUME_ATTEMPT", "0") or 0) > 0)
         self._pool = None  # lazy; lives for the store's lifetime
         # liveness: periodic heartbeat so the server can report dead peers
         # and release stuck barriers (kvstore_dist.h:151-160 parity)
@@ -259,7 +267,11 @@ class DistAsyncKVStore(KVStore):
                         self._clients[cid].init(k, flat[lo:hi])
                 else:
                     self._clients[self._server_for(k)].init(k, arr)
-        self._client.barrier()
+        # the server decides whether a recovered worker may skip (only
+        # once the job passed startup — see KVStoreServer barrier); the
+        # init sends above are setdefault-safe either way
+        self._client.barrier(rank=self._rank,
+                             is_recovery=self._is_recovery)
 
     def push(self, key, value, priority=0):
         keys, _ = _key_list(key)
@@ -338,12 +350,16 @@ class DistAsyncKVStore(KVStore):
         """Ship the pickled optimizer to every server (reference
         kvstore.py:232-255 _send_command_to_servers)."""
         if self._rank == 0:
+            # recovery flag travels with the command: the server keeps
+            # its live updater (momentum state) when one is installed
             for c in self._clients:
-                c.set_optimizer(optimizer)
-        self._client.barrier()
+                c.set_optimizer(optimizer, is_recovery=self._is_recovery)
+        self._client.barrier(rank=self._rank,
+                             is_recovery=self._is_recovery)
 
     def _barrier(self):
-        self._client.barrier()
+        self._client.barrier(rank=self._rank,
+                             is_recovery=self._is_recovery)
 
     def _send_command_to_servers(self, head, body):
         if head == "stop":

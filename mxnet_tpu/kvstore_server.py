@@ -152,9 +152,14 @@ class KVStoreServer:
                     return ("err", "uninitialized key %r" % (key,))
                 return ("ok", self.store[key])
         if cmd == "set_optimizer":
+            is_recovery = bool(msg[2]) if len(msg) > 2 else False
             optimizer = pickle.loads(msg[1])
             with self._lock:
-                self.updater = opt.get_updater(optimizer)
+                # a rejoining rank 0 re-ships the optimizer it launched
+                # with; installing it fresh would reset live momentum
+                # state mid-training — keep the installed updater
+                if not (is_recovery and self.updater is not None):
+                    self.updater = opt.get_updater(optimizer)
             return ("ok",)
         if cmd == "heartbeat":
             rank = int(msg[1])
@@ -165,12 +170,21 @@ class KVStoreServer:
             timeout_s = float(msg[1]) if len(msg) > 1 else 60.0
             return ("ok", self._dead_nodes(timeout_s))
         if cmd == "barrier":
+            is_recovery = bool(msg[2]) if len(msg) > 2 else False
             timeout = float(os.environ.get("MXNET_KVSTORE_BARRIER_TIMEOUT",
                                            "600"))
             hb_timeout = float(os.environ.get(
                 "MXNET_KVSTORE_DEAD_TIMEOUT", "60"))
             deadline = time.monotonic() + timeout
             with self._barrier_cv:
+                # rejoin semantics (reference kvstore_dist.h:35-38): a
+                # recovered worker skips barriers ONLY once the job has
+                # passed startup (some barrier generation completed, so
+                # its peers are mid-training and will never arrive). A
+                # worker that crashed BEFORE the first barrier completed
+                # must join normally or it deadlocks the waiting peers.
+                if is_recovery and self._barrier_gen > 0:
+                    return ("ok",)
                 gen = self._barrier_gen
                 self._barrier_count += 1
                 if self._barrier_count >= self.num_workers:
@@ -314,12 +328,13 @@ class ServerClient:
     def pull(self, key):
         return self._rpc("pull", key)
 
-    def set_optimizer(self, optimizer):
+    def set_optimizer(self, optimizer, is_recovery=False):
         self._rpc("set_optimizer",
-                  pickle.dumps(optimizer, pickle.HIGHEST_PROTOCOL))
+                  pickle.dumps(optimizer, pickle.HIGHEST_PROTOCOL),
+                  int(is_recovery))
 
-    def barrier(self):
-        self._rpc("barrier")
+    def barrier(self, rank=0, is_recovery=False):
+        self._rpc("barrier", rank, int(is_recovery))
 
     def stop_server(self):
         self._rpc("stop")

@@ -32,6 +32,9 @@ struct SymHandle {
 struct ExecHandle {
   long long hid;
 };
+struct IterHandle {
+  long long hid;
+};
 
 // Per-thread backing for returned arrays (reference c_api uses
 // thread-local return stores the same way).
@@ -271,6 +274,110 @@ int MXTPUImperativeInvoke(const char* op_name, int num_inputs, void** inputs,
 
 int MXTPUFreeHandleArray(void** arr) {
   free(arr);
+  return 0;
+}
+
+/* ------------------------------------------------------------------ */
+/* DataIter surface (shim: iter_* functions in capi_shim.py;
+ * reference c_api.cc:446-543)                                         */
+
+int MXTPUListDataIters(mx_uint* out_size, const char*** out_array) {
+  ensure_python();
+  GIL gil;
+  PyObject* res = call_shim("iter_list", "()");
+  if (!res) return -1;
+  Py_ssize_t n = PyList_Size(res);
+  t_names_store.resize(n);
+  t_names.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    t_names_store[i] = PyUnicode_AsUTF8(PyList_GET_ITEM(res, i));
+    t_names[i] = t_names_store[i].c_str();
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = t_names.data();
+  return 0;
+}
+
+int MXTPUDataIterCreate(const char* name, mx_uint num_params,
+                        const char** keys, const char** vals, void** out) {
+  ensure_python();
+  GIL gil;
+  PyObject* pkeys = PyList_New(num_params);
+  PyObject* pvals = PyList_New(num_params);
+  for (mx_uint i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(pvals, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* res = call_shim("iter_create", "(sOO)", name, pkeys, pvals);
+  Py_DECREF(pkeys);
+  Py_DECREF(pvals);
+  if (!res) return -1;
+  *out = new IterHandle{PyLong_AsLongLong(res)};
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUDataIterNext(void* handle, int* out) {
+  GIL gil;
+  PyObject* res = call_shim("iter_next", "(L)",
+                            static_cast<IterHandle*>(handle)->hid);
+  if (!res) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUDataIterBeforeFirst(void* handle) {
+  GIL gil;
+  PyObject* res = call_shim("iter_before_first", "(L)",
+                            static_cast<IterHandle*>(handle)->hid);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+namespace {
+int iter_fetch_nd(void* handle, const char* fn, void** out) {
+  GIL gil;
+  PyObject* res =
+      call_shim(fn, "(L)", static_cast<IterHandle*>(handle)->hid);
+  if (!res) return -1;
+  *out = new NDHandle{PyLong_AsLongLong(res)};
+  Py_DECREF(res);
+  return 0;
+}
+}  // namespace
+
+/* The returned NDArrayHandle is caller-owned (MXTPUNDArrayFree). */
+int MXTPUDataIterGetData(void* handle, void** out) {
+  return iter_fetch_nd(handle, "iter_get_data", out);
+}
+
+int MXTPUDataIterGetLabel(void* handle, void** out) {
+  return iter_fetch_nd(handle, "iter_get_label", out);
+}
+
+int MXTPUDataIterGetPadNum(void* handle, int* out) {
+  GIL gil;
+  PyObject* res = call_shim("iter_get_pad", "(L)",
+                            static_cast<IterHandle*>(handle)->hid);
+  if (!res) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUDataIterFree(void* handle) {
+  auto* h = static_cast<IterHandle*>(handle);
+  if (!h) return 0;
+  {
+    GIL gil;
+    PyObject* res = call_shim("iter_free", "(L)", h->hid);
+    if (res) Py_DECREF(res);
+    else PyErr_Clear();
+  }
+  delete h;
   return 0;
 }
 

@@ -246,3 +246,111 @@ def test_dist_async_bigarray_range_split(monkeypatch):
     finally:
         s0.stop()
         s1.stop()
+
+
+def test_dist_async_recovery_worker_skips_barrier(monkeypatch):
+    """Rejoin semantics (reference kvstore_dist.h:35-38 IsRecovery): a
+    relaunched worker's init/set_optimizer must NOT wait at the startup
+    barrier — its peers are mid-training and will never arrive — must not
+    clobber the server's live weights, and must pull the current ones."""
+    srv = kvs.start_server(num_workers=2)  # barrier needs 2: would hang
+    try:
+        host, port = srv.addr
+        # the job passed startup (one full barrier generation) and
+        # trained for a while before the worker died
+        live = kvs.ServerClient(host, port)
+        live2 = kvs.ServerClient(host, port)
+        t0 = threading.Thread(target=lambda: live2.barrier(rank=0))
+        t0.start()
+        live.barrier(rank=1)
+        t0.join(timeout=10)
+        live.init("w", np.zeros((4,), np.float32))
+        live.push("w", np.full((4,), 7.0, np.float32))
+
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", host)
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+        monkeypatch.setenv("DMLC_WORKER_ID", "1")
+        monkeypatch.setenv("DMLC_IS_RECOVERY", "1")
+
+        done = {}
+
+        def rejoin():
+            kv = mx.kvstore.create("dist_async")
+            try:
+                # re-init must return immediately (no barrier) and must
+                # not reset the trained value (server init is setdefault)
+                kv.init("w", mx.nd.zeros((4,)))
+                kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+                out = mx.nd.zeros((4,))
+                kv.pull("w", out=out)
+                done["w"] = out.asnumpy()
+            finally:
+                kv.close()
+
+        t = threading.Thread(target=rejoin)
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive(), \
+            "recovery worker blocked at the startup barrier"
+        assert_almost_equal(done["w"], np.full((4,), 7.0, np.float32))
+    finally:
+        srv.stop()
+
+
+def test_recovery_before_startup_joins_barrier():
+    """The deadlock guard: a worker relaunched BEFORE the job's first
+    barrier completed must JOIN the startup barrier (completing it for
+    the waiting peers), not skip it — skipping would strand the peers
+    until the 600s timeout."""
+    srv = kvs.start_server(num_workers=2)
+    try:
+        host, port = srv.addr
+        results = []
+
+        def healthy():
+            c = kvs.ServerClient(host, port)
+            c.barrier(rank=0)  # waits for the second worker
+            results.append("healthy")
+
+        def recovered():
+            time.sleep(0.3)
+            c = kvs.ServerClient(host, port)
+            # is_recovery, but no generation has completed: must join
+            c.barrier(rank=1, is_recovery=True)
+            results.append("recovered")
+
+        ts = [threading.Thread(target=healthy),
+              threading.Thread(target=recovered)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=15)
+        assert sorted(results) == ["healthy", "recovered"], results
+    finally:
+        srv.stop()
+
+
+def test_recovery_set_optimizer_keeps_live_updater():
+    """A rejoining rank 0 re-ships its optimizer; the server must keep
+    the installed updater (its momentum state is live mid-training)."""
+    srv = kvs.start_server(num_workers=1)
+    try:
+        host, port = srv.addr
+        c = kvs.ServerClient(host, port)
+        c.init("w", np.ones((2,), np.float32))
+        c.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+        first = srv.updater
+        # recovery re-ship: ignored while an updater is installed
+        c.set_optimizer(mx.optimizer.SGD(learning_rate=0.1),
+                        is_recovery=True)
+        assert srv.updater is first
+        # but a recovery send with NO updater installed (crash before
+        # set_optimizer completed) does install
+        srv.updater = None
+        c.set_optimizer(mx.optimizer.SGD(learning_rate=0.1),
+                        is_recovery=True)
+        assert srv.updater is not None
+    finally:
+        srv.stop()

@@ -43,3 +43,8 @@ ENTRY %main (a: f32[4]) -> f32[4] {
                  "convs_fused_with_elementwise_epilogue": 1,
                  "convs_fused_plain": 1,
                  "convs_bare_in_entry": 1}, r
+
+    # modern compiled.as_text() dumps omit the % name sigil entirely —
+    # classification must be identical on the sigil-less form
+    r2 = bn_fusion_analysis(synthetic.replace("%", ""))
+    assert r2 == r, r2

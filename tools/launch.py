@@ -165,6 +165,9 @@ def main():
                         attempts[i] += 1
                         env = dict(worker_envs[i])
                         env["MXNET_AUTORESUME_ATTEMPT"] = str(attempts[i])
+                        # rejoin contract (reference kvstore_dist.h:35-38):
+                        # recovered workers skip startup barriers
+                        env["DMLC_IS_RECOVERY"] = "1"
                         print("launch.py: worker %d exited rc=%d; "
                               "relaunch %d/%d" % (i, r, attempts[i],
                                                   args.auto_resume),

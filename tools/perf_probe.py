@@ -59,13 +59,16 @@ def bn_fusion_analysis(hlo_text):
     entry-level instructions are separate kernels even when an
     elementwise op consumes them there (worth ~2 MFU points per PERF.md's
     control-minus-BN-stats data if that is where BN's scale/shift run)."""
-    # computations: optional ENTRY prefix, then '%name (...) -> ... {'
+    # computations: optional ENTRY prefix, then 'name (...) -> ... {'.
+    # The '%' name sigil is optional THROUGHOUT: modern compiled.as_text()
+    # dumps omit it ('convolution.3 = f32[...] convolution(arg.1, ...)'),
+    # classic dumps keep it — names are normalized sigil-less.
     blocks = re.findall(r"^(ENTRY\s+)?%?[\w.-]+ [^\n]*\{\n(.*?)^\s*\}",
                         hlo_text, re.M | re.S)
     fused = fused_plain = bare = 0
     for entry_prefix, body in blocks:
-        conv_names = [m.group(1) for m in re.finditer(
-            r"(%[\w.-]+)\s*=\s*\S+\s+convolution\(", body)]
+        conv_names = [m.group(1).lstrip("%") for m in re.finditer(
+            r"(%?[\w.-]+)\s*=\s*\S+\s+convolution\(", body)]
         if not conv_names:
             continue
         if entry_prefix:
@@ -74,7 +77,9 @@ def bn_fusion_analysis(hlo_text):
         ew_operands = set()
         for m in re.finditer(
                 r"=\s*\S+\s+(?:multiply|add|subtract)\(([^)]*)\)", body):
-            ew_operands.update(re.findall(r"%[\w.-]+", m.group(1)))
+            ew_operands.update(
+                t.lstrip("%")
+                for t in re.findall(r"%?[\w][\w.-]*", m.group(1)))
         for c in conv_names:
             if c in ew_operands:
                 fused += 1
