@@ -90,6 +90,33 @@ int MXTPUImperativeInvoke(const char* op_name, int num_inputs,
                           const char** param_keys, const char** param_vals);
 
 /* ------------------------------------------------------------------ */
+/* KVStore surface — parameter synchronization from C.  Reference
+ * analogue: c_api.cc:544-700 (MXKVStoreCreate/Init/Push/Pull/GetType/
+ * GetRank/GetGroupSize/Barrier).  The C updater callback
+ * (MXKVStoreSetUpdater) is intentionally absent: the updater here is
+ * the server-side optimizer (dist_async) or the compiled-in psum
+ * (dist_sync); the local store's default merge is summing. */
+
+typedef void* KVStoreHandle;
+
+/* type: "local", "device", "dist_sync", "dist_device_sync",
+ * "dist_async" — dist flavors read the DMLC_* env contract. */
+int MXTPUKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXTPUKVStoreFree(KVStoreHandle handle);
+int MXTPUKVStoreInit(KVStoreHandle handle, mx_uint num, const int* keys,
+                     NDArrayHandle* vals);
+int MXTPUKVStorePush(KVStoreHandle handle, mx_uint num, const int* keys,
+                     NDArrayHandle* vals);
+/* Fills the caller's NDArray handles in place. */
+int MXTPUKVStorePull(KVStoreHandle handle, mx_uint num, const int* keys,
+                     NDArrayHandle* vals);
+/* *out_type is thread-local storage, valid until the next call. */
+int MXTPUKVStoreGetType(KVStoreHandle handle, const char** out_type);
+int MXTPUKVStoreGetRank(KVStoreHandle handle, int* out);
+int MXTPUKVStoreGetGroupSize(KVStoreHandle handle, int* out);
+int MXTPUKVStoreBarrier(KVStoreHandle handle);
+
+/* ------------------------------------------------------------------ */
 /* DataIter surface — drive the file-backed input pipeline from C.
  * Reference analogue: c_api.cc:446-543 (MXListDataIters,
  * MXDataIterCreateIter/Next/GetData/GetLabel/GetPadNum/BeforeFirst).

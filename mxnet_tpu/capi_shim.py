@@ -418,3 +418,71 @@ def iter_get_pad(hid):
 
 def iter_free(hid):
     _dataiters.pop(hid)
+
+
+# ---------------------------------------------------------------------------
+# KVStore C API backing (src/c_api.cc — the reference's c_api.cc:544-700
+# MXKVStoreCreate/Init/Push/Pull/GetType/GetRank/GetGroupSize/Barrier).
+# The C updater callback (MXKVStoreSetUpdater) is not exposed: on this
+# framework the updater is the server-side optimizer (set via Python or
+# the launcher), and the local kvstore's default is summing — matching
+# how Module drives it.
+# ---------------------------------------------------------------------------
+
+_kvstores = _HandleRegistry()
+
+
+def kv_create(kv_type):
+    from . import kvstore
+
+    return _kvstores.put(kvstore.create(kv_type))
+
+
+def _kv_get(hid):
+    return _kvstores.get(hid, "KVStore")
+
+
+def kv_free(hid):
+    try:
+        kv = _kv_get(hid)
+    except KeyError:
+        return
+    if hasattr(kv, "close"):
+        try:
+            kv.close()
+        except Exception:
+            pass
+    _kvstores.pop(hid)
+
+
+def kv_init(hid, keys, nd_hids):
+    kv = _kv_get(hid)
+    kv.init(list(keys), [_nd_get(h) for h in nd_hids])
+
+
+def kv_push(hid, keys, nd_hids):
+    kv = _kv_get(hid)
+    kv.push(list(keys), [_nd_get(h) for h in nd_hids])
+
+
+def kv_pull(hid, keys, nd_hids):
+    """Pull INTO the caller's existing NDArray handles (reference
+    MXKVStorePull semantics: out buffers are caller-provided)."""
+    kv = _kv_get(hid)
+    kv.pull(list(keys), out=[_nd_get(h) for h in nd_hids])
+
+
+def kv_type(hid):
+    return _kv_get(hid).type
+
+
+def kv_rank(hid):
+    return int(_kv_get(hid).rank)
+
+
+def kv_group_size(hid):
+    return int(_kv_get(hid).num_workers)
+
+
+def kv_barrier(hid):
+    _kv_get(hid)._barrier()

@@ -203,7 +203,12 @@ class DistAsyncKVStore(KVStore):
         # a relaunched worker must NOT wait at startup barriers — its
         # peers are mid-training and will never arrive. Server state is
         # safe: init is setdefault on the server, so re-init cannot
-        # clobber trained weights; the worker pulls current ones.
+        # clobber trained weights; the worker pulls current ones. The
+        # flag covers ONLY the bring-up phase: it expires at the first
+        # push (bring-up itself pulls — Module interleaves init/pull per
+        # parameter), so later barriers participate normally and a later
+        # legitimate set_optimizer (LR drop at an epoch boundary)
+        # installs instead of being dropped as a recovery re-ship.
         self._is_recovery = (
             os.environ.get("DMLC_IS_RECOVERY", "") == "1"
             or int(os.environ.get("MXNET_AUTORESUME_ATTEMPT", "0") or 0) > 0)
@@ -274,6 +279,7 @@ class DistAsyncKVStore(KVStore):
                              is_recovery=self._is_recovery)
 
     def push(self, key, value, priority=0):
+        self._is_recovery = False  # training traffic: bring-up is over
         keys, _ = _key_list(key)
         vals = _val_list(value, len(keys))
         for k, vlist in zip(keys, vals):
@@ -291,6 +297,10 @@ class DistAsyncKVStore(KVStore):
                     k, merged, rank=self._rank)
 
     def pull(self, key, out=None, priority=0):
+        # NOTE: pull must NOT clear _is_recovery — Module bring-up
+        # interleaves init/pull per parameter (model.py
+        # _initialize_kvstore) before set_optimizer ever runs; only push
+        # marks real training traffic.
         import jax
 
         keys, _ = _key_list(key)

@@ -80,7 +80,7 @@ class KVStoreServer:
         self.store: Dict[object, np.ndarray] = {}
         self.updater = None
         self._lock = threading.Lock()  # single-threaded-executor parity
-        self._barrier_count = 0
+        self._barrier_ranks = set()
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
         self._merge: Dict[object, list] = {}
@@ -170,6 +170,7 @@ class KVStoreServer:
             timeout_s = float(msg[1]) if len(msg) > 1 else 60.0
             return ("ok", self._dead_nodes(timeout_s))
         if cmd == "barrier":
+            rank = int(msg[1]) if len(msg) > 1 else 0
             is_recovery = bool(msg[2]) if len(msg) > 2 else False
             timeout = float(os.environ.get("MXNET_KVSTORE_BARRIER_TIMEOUT",
                                            "600"))
@@ -178,17 +179,20 @@ class KVStoreServer:
             deadline = time.monotonic() + timeout
             with self._barrier_cv:
                 # rejoin semantics (reference kvstore_dist.h:35-38): a
-                # recovered worker skips barriers ONLY once the job has
-                # passed startup (some barrier generation completed, so
-                # its peers are mid-training and will never arrive). A
-                # worker that crashed BEFORE the first barrier completed
-                # must join normally or it deadlocks the waiting peers.
-                if is_recovery and self._barrier_gen > 0:
+                # recovered worker skips a barrier only when the job has
+                # passed startup (a generation completed) AND no peers
+                # are currently parked at one — if they are, it must
+                # join and release them (they count num_workers arrivals
+                # and would otherwise wedge until the timeout). Arrivals
+                # are tracked per RANK so a worker that crashed after
+                # arriving cannot double-count on rejoin.
+                if (is_recovery and self._barrier_gen > 0
+                        and not self._barrier_ranks):
                     return ("ok",)
                 gen = self._barrier_gen
-                self._barrier_count += 1
-                if self._barrier_count >= self.num_workers:
-                    self._barrier_count = 0
+                self._barrier_ranks.add(rank)
+                if len(self._barrier_ranks) >= self.num_workers:
+                    self._barrier_ranks = set()
                     self._barrier_gen += 1
                     self._barrier_cv.notify_all()
                     return ("ok",)
@@ -207,12 +211,12 @@ class KVStoreServer:
                     dead = self._dead_nodes(hb_timeout)
                     if dead:
                         if self._barrier_gen == gen:
-                            self._barrier_count -= 1
+                            self._barrier_ranks.discard(rank)
                         return ("err", "barrier aborted: dead workers %s"
                                 % dead)
                     if time.monotonic() >= deadline:
                         if self._barrier_gen == gen:
-                            self._barrier_count -= 1
+                            self._barrier_ranks.discard(rank)
                         return ("err",
                                 "barrier timed out after %.0fs" % timeout)
         if cmd == "stop":

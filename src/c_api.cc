@@ -35,6 +35,9 @@ struct ExecHandle {
 struct IterHandle {
   long long hid;
 };
+struct KVHandle {
+  long long hid;
+};
 
 // Per-thread backing for returned arrays (reference c_api uses
 // thread-local return stores the same way).
@@ -42,6 +45,30 @@ thread_local std::vector<mx_uint> t_shape;
 thread_local std::vector<std::string> t_names_store;
 thread_local std::vector<const char*> t_names;
 thread_local std::string t_json;
+
+// Marshal a shim-returned list of strings into the shared thread-local
+// name table (library-owned, valid until the next call — header
+// contract).  Consumes the reference to `res`.
+int fill_name_table(PyObject* res, mx_uint* out_size,
+                    const char*** out_array) {
+  Py_ssize_t n = PyList_Size(res);
+  if (n < 0) {
+    PyErr_Clear();
+    Py_DECREF(res);
+    mxtpu_capi::set_error("shim returned a non-list name table");
+    return -1;
+  }
+  t_names_store.resize(n);
+  t_names.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    t_names_store[i] = PyUnicode_AsUTF8(PyList_GET_ITEM(res, i));
+    t_names[i] = t_names_store[i].c_str();
+  }
+  Py_DECREF(res);
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = t_names.data();
+  return 0;
+}
 
 }  // namespace
 
@@ -216,17 +243,7 @@ int MXTPUListAllOpNames(mx_uint* out_size, const char*** out_array) {
   GIL gil;
   PyObject* res = call_shim("list_op_names", "()");
   if (!res) return -1;
-  Py_ssize_t n = PyList_Size(res);
-  t_names_store.resize(n);
-  t_names.resize(n);
-  for (Py_ssize_t i = 0; i < n; ++i) {
-    t_names_store[i] = PyUnicode_AsUTF8(PyList_GET_ITEM(res, i));
-    t_names[i] = t_names_store[i].c_str();
-  }
-  Py_DECREF(res);
-  *out_size = static_cast<mx_uint>(n);
-  *out_array = t_names.data();
-  return 0;
+  return fill_name_table(res, out_size, out_array);
 }
 
 int MXTPUImperativeInvoke(const char* op_name, int num_inputs, void** inputs,
@@ -278,6 +295,111 @@ int MXTPUFreeHandleArray(void** arr) {
 }
 
 /* ------------------------------------------------------------------ */
+/* KVStore surface (shim: kv_* functions in capi_shim.py;
+ * reference c_api.cc:544-700)                                         */
+
+int MXTPUKVStoreCreate(const char* type, void** out) {
+  ensure_python();
+  GIL gil;
+  PyObject* res = call_shim("kv_create", "(s)", type);
+  if (!res) return -1;
+  *out = new KVHandle{PyLong_AsLongLong(res)};
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUKVStoreFree(void* handle) {
+  auto* h = static_cast<KVHandle*>(handle);
+  if (!h) return 0;
+  {
+    GIL gil;
+    PyObject* res = call_shim("kv_free", "(L)", h->hid);
+    if (res) Py_DECREF(res);
+    else PyErr_Clear();
+  }
+  delete h;
+  return 0;
+}
+
+namespace {
+int kv_keyed_call(void* handle, const char* fn, mx_uint num,
+                  const int* keys, void** vals) {
+  GIL gil;
+  PyObject* pkeys = PyList_New(num);
+  PyObject* pvals = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    PyList_SET_ITEM(pkeys, i, PyLong_FromLong(keys[i]));
+    PyList_SET_ITEM(pvals, i, PyLong_FromLongLong(
+        static_cast<NDHandle*>(vals[i])->hid));
+  }
+  PyObject* res = call_shim(fn, "(LOO)",
+                            static_cast<KVHandle*>(handle)->hid, pkeys,
+                            pvals);
+  Py_DECREF(pkeys);
+  Py_DECREF(pvals);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+}  // namespace
+
+int MXTPUKVStoreInit(void* handle, mx_uint num, const int* keys,
+                     void** vals) {
+  return kv_keyed_call(handle, "kv_init", num, keys, vals);
+}
+
+int MXTPUKVStorePush(void* handle, mx_uint num, const int* keys,
+                     void** vals) {
+  return kv_keyed_call(handle, "kv_push", num, keys, vals);
+}
+
+/* Pull fills the CALLER's NDArray handles in place. */
+int MXTPUKVStorePull(void* handle, mx_uint num, const int* keys,
+                     void** vals) {
+  return kv_keyed_call(handle, "kv_pull", num, keys, vals);
+}
+
+int MXTPUKVStoreGetType(void* handle, const char** out_type) {
+  GIL gil;
+  PyObject* res = call_shim("kv_type", "(L)",
+                            static_cast<KVHandle*>(handle)->hid);
+  if (!res) return -1;
+  t_json = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out_type = t_json.c_str();
+  return 0;
+}
+
+int MXTPUKVStoreGetRank(void* handle, int* out) {
+  GIL gil;
+  PyObject* res = call_shim("kv_rank", "(L)",
+                            static_cast<KVHandle*>(handle)->hid);
+  if (!res) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUKVStoreGetGroupSize(void* handle, int* out) {
+  GIL gil;
+  PyObject* res = call_shim("kv_group_size", "(L)",
+                            static_cast<KVHandle*>(handle)->hid);
+  if (!res) return -1;
+  *out = static_cast<int>(PyLong_AsLong(res));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUKVStoreBarrier(void* handle) {
+  GIL gil;
+  PyObject* res = call_shim("kv_barrier", "(L)",
+                            static_cast<KVHandle*>(handle)->hid);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+/* ------------------------------------------------------------------ */
 /* DataIter surface (shim: iter_* functions in capi_shim.py;
  * reference c_api.cc:446-543)                                         */
 
@@ -286,17 +408,7 @@ int MXTPUListDataIters(mx_uint* out_size, const char*** out_array) {
   GIL gil;
   PyObject* res = call_shim("iter_list", "()");
   if (!res) return -1;
-  Py_ssize_t n = PyList_Size(res);
-  t_names_store.resize(n);
-  t_names.resize(n);
-  for (Py_ssize_t i = 0; i < n; ++i) {
-    t_names_store[i] = PyUnicode_AsUTF8(PyList_GET_ITEM(res, i));
-    t_names[i] = t_names_store[i].c_str();
-  }
-  Py_DECREF(res);
-  *out_size = static_cast<mx_uint>(n);
-  *out_array = t_names.data();
-  return 0;
+  return fill_name_table(res, out_size, out_array);
 }
 
 int MXTPUDataIterCreate(const char* name, mx_uint num_params,
@@ -414,31 +526,6 @@ int MXTPUSymbolSaveToJSON(void* sym, const char** out_json) {
   *out_json = t_json.c_str();
   return 0;
 }
-
-namespace {
-// Marshal a shim-returned list of strings into the shared thread-local
-// name table (library-owned, valid until the next call — header contract).
-int fill_name_table(PyObject* res, mx_uint* out_size,
-                    const char*** out_array) {
-  Py_ssize_t n = PyList_Size(res);
-  if (n < 0) {
-    PyErr_Clear();
-    Py_DECREF(res);
-    set_error("shim returned a non-list name table");
-    return -1;
-  }
-  t_names_store.resize(n);
-  t_names.resize(n);
-  for (Py_ssize_t i = 0; i < n; ++i) {
-    t_names_store[i] = PyUnicode_AsUTF8(PyList_GET_ITEM(res, i));
-    t_names[i] = t_names_store[i].c_str();
-  }
-  Py_DECREF(res);
-  *out_size = static_cast<mx_uint>(n);
-  *out_array = t_names.data();
-  return 0;
-}
-}  // namespace
 
 int MXTPUSymbolListArguments(void* sym, mx_uint* out_size,
                              const char*** out_array) {

@@ -479,3 +479,56 @@ def test_c_dataiter_surface(tmp_path):
                                    ctypes.byref(bad)) == -1
     assert b"NoSuchIter" in lib.MXTPUGetLastError()
     lib.MXTPUDataIterFree(it)
+
+
+def test_c_kvstore_surface():
+    """KVStore from C (reference c_api.cc:544-700): create local store,
+    init/push/pull with int keys, rank/size/type getters, barrier no-op
+    on the local store."""
+    lib = _build_lib()
+    lib.MXTPUKVStoreGetType.restype = ctypes.c_int
+
+    kv = ctypes.c_void_p()
+    assert lib.MXTPUKVStoreCreate(b"local", ctypes.byref(kv)) == 0, \
+        lib.MXTPUGetLastError().decode()
+
+    tp = ctypes.c_char_p()
+    assert lib.MXTPUKVStoreGetType(kv, ctypes.byref(tp)) == 0
+    assert tp.value == b"local"
+    rank = ctypes.c_int()
+    size = ctypes.c_int()
+    assert lib.MXTPUKVStoreGetRank(kv, ctypes.byref(rank)) == 0
+    assert lib.MXTPUKVStoreGetGroupSize(kv, ctypes.byref(size)) == 0
+    assert rank.value == 0 and size.value == 1
+
+    def make_nd(a):
+        h = ctypes.c_void_p()
+        shp = (ctypes.c_uint32 * a.ndim)(*a.shape)
+        assert lib.MXTPUNDArrayCreate(shp, a.ndim, 1, 0, 0,
+                                      ctypes.byref(h)) == 0
+        assert lib.MXTPUNDArraySyncCopyFromCPU(
+            h, a.ctypes.data_as(ctypes.c_void_p), a.nbytes) == 0
+        return h
+
+    w = np.arange(6, dtype=np.float32).reshape(2, 3)
+    g = np.ones((2, 3), np.float32)
+    wh, gh = make_nd(w), make_nd(g)
+    keys = (ctypes.c_int * 1)(3)
+    vals_w = (ctypes.c_void_p * 1)(wh.value)
+    vals_g = (ctypes.c_void_p * 1)(gh.value)
+    assert lib.MXTPUKVStoreInit(kv, 1, keys, vals_w) == 0
+    assert lib.MXTPUKVStorePush(kv, 1, keys, vals_g) == 0
+    assert lib.MXTPUKVStorePush(kv, 1, keys, vals_g) == 0
+    outh = make_nd(np.zeros((2, 3), np.float32))
+    vals_o = (ctypes.c_void_p * 1)(outh.value)
+    assert lib.MXTPUKVStorePull(kv, 1, keys, vals_o) == 0
+    got = np.zeros((2, 3), np.float32)
+    assert lib.MXTPUNDArraySyncCopyToCPU(
+        outh, got.ctypes.data_as(ctypes.c_void_p), got.nbytes) == 0
+    # local-store semantics (kvstore_local.h:50): each push REPLACES the
+    # store with that push's merged value; pull returns the last merge
+    np.testing.assert_allclose(got, g)
+    assert lib.MXTPUKVStoreBarrier(kv) == 0
+    for h in (wh, gh, outh):
+        lib.MXTPUNDArrayFree(h)
+    assert lib.MXTPUKVStoreFree(kv) == 0

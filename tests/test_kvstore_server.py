@@ -116,7 +116,7 @@ def test_server_barrier():
             c = kvs.ServerClient(host, port)
             if i == 1:
                 time.sleep(0.3)
-            c.barrier()
+            c.barrier(rank=i)  # arrivals are rank-keyed
             order.append(i)
 
         ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
@@ -352,5 +352,40 @@ def test_recovery_set_optimizer_keeps_live_updater():
         c.set_optimizer(mx.optimizer.SGD(learning_rate=0.1),
                         is_recovery=True)
         assert srv.updater is not None
+    finally:
+        srv.stop()
+
+
+def test_recovery_joins_pending_barrier_mid_training():
+    """Even past startup, a recovered worker must JOIN a barrier peers
+    are already parked at (they count num_workers arrivals; skipping
+    would wedge them to the 600s timeout) — and skip only when nobody
+    is waiting."""
+    srv = kvs.start_server(num_workers=2)
+    try:
+        host, port = srv.addr
+        a = kvs.ServerClient(host, port)
+        b = kvs.ServerClient(host, port)
+        # pass startup: one full generation
+        t = threading.Thread(target=lambda: a.barrier(rank=0))
+        t.start()
+        b.barrier(rank=1)
+        t.join(timeout=10)
+
+        # rank 0 parks at a new barrier; recovered rank 1 must release it
+        released = []
+        t = threading.Thread(
+            target=lambda: (a.barrier(rank=0), released.append(True)))
+        t.start()
+        time.sleep(0.3)
+        assert not released  # genuinely parked
+        b.barrier(rank=1, is_recovery=True)  # pending -> joins
+        t.join(timeout=10)
+        assert released, "recovery join did not release the parked peer"
+
+        # nobody waiting now: recovery barrier returns immediately
+        t0 = time.time()
+        b.barrier(rank=1, is_recovery=True)
+        assert time.time() - t0 < 2.0
     finally:
         srv.stop()
