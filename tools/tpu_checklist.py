@@ -7,9 +7,16 @@ recovered/fresh chip can be fully validated in one command).
   3. 16k-token causal train step (the long-sequence claim)
   4. ring_flash_attention causal on a 1-device mesh (traces all switch
      branches under the TPU vma checker)
-  5. bench.py headline (ResNet-50 Module path) unless --skip-resnet
+  5. bench.py headline (ResNet-50 Module path + transformer_lm_mfu
+     model-level metric) unless --skip-resnet
+  6. upstream splash-attention oracle at --seq (the ceiling our kernel
+     chases; --skip-oracle to omit)
 
-Usage: python tools/tpu_checklist.py [--skip-resnet]
+After the checklist, run ``python tools/perf_probe.py`` separately for
+the XLA cost analysis + bn_fusion classification (it builds its own
+Module; keeping it out-of-process avoids doubling HBM residency).
+
+Usage: python tools/tpu_checklist.py [--skip-resnet] [--skip-oracle]
 """
 import argparse
 import json
@@ -28,6 +35,7 @@ def report(name, **kw):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-resnet", action="store_true")
+    ap.add_argument("--skip-oracle", action="store_true")
     ap.add_argument("--seq", type=int, default=8192)
     cli = ap.parse_args()
 
@@ -157,6 +165,18 @@ def main():
             report("resnet50_bench", ok=False, error=str(e)[:200])
         finally:
             sys.argv = argv
+
+    # 6. upstream splash attention — the mature TPU kernel as the MFU
+    # ceiling reference for our flash numbers at the same shape
+    if not cli.skip_oracle:
+        from bench_attention import run_oracle_bench
+
+        try:
+            with deadline(900):
+                orc = run_oracle_bench(seq=cli.seq, steps=5)
+            report("splash_oracle", result=orc, ok=True)
+        except Exception as e:
+            report("splash_oracle", ok=False, error=str(e)[:200])
 
 
 if __name__ == "__main__":
