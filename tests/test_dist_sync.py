@@ -155,3 +155,46 @@ def test_auto_resume_kill_relaunch_converge(tmp_path):
     # checkpoints for both attempts' epochs exist (2 from attempt 0)
     import mxnet_tpu as mx
     assert mx.model.find_latest_checkpoint(str(tmp_path / "ar")) == 10
+
+
+def test_ssh_launcher_publishes_server_uris(tmp_path):
+    """ssh mode with parameter servers: the launcher must publish the
+    authoritative DMLC_SERVER_URIS list (hosts round-robin, root_port+i)
+    to every process — workers cannot derive server placement from the
+    root URI alone (kvstore.py DistAsyncKVStore address derivation)."""
+    stub_dir = tmp_path / "bin"
+    stub_dir.mkdir()
+    stub = stub_dir / "ssh"
+    stub.write_text("#!/bin/bash\nshift 4\nhost=$1; shift\n"
+                    "exec bash -c \"$1\"\n")
+    stub.chmod(0o755)
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("hostA\nhostB\n")
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    prog = tmp_path / "prog.sh"
+    prog.write_text(
+        "#!/bin/bash\n"
+        "echo \"$DMLC_ROLE $DMLC_SERVER_ID$DMLC_WORKER_ID "
+        "$DMLC_SERVER_URIS $DMLC_PS_ROOT_URI\" "
+        ">> %s/$DMLC_ROLE-$DMLC_SERVER_ID$DMLC_WORKER_ID\n" % outdir)
+    prog.chmod(0o755)
+
+    env = dict(os.environ)
+    env["PATH"] = "%s:%s" % (stub_dir, env["PATH"])
+    env.pop("DMLC_ROLE", None)
+    env.pop("DMLC_PS_ROOT_URI", None)  # launch.py prefers an inherited URI
+    env["DMLC_PS_ROOT_PORT"] = "9500"
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "--launcher", "ssh", "--hostfile", str(hostfile),
+         "-n", "2", "-s", "2", "bash", str(prog)],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+
+    uris = "hostA:9500,hostB:9501"
+    for fname in ("server-0", "server-1", "worker-0", "worker-1"):
+        role, rid, got_uris, root = \
+            (outdir / fname).read_text().split()
+        assert got_uris == uris, (fname, got_uris)
+        assert root == "hostA"  # coordinator on the first hostfile entry

@@ -389,3 +389,44 @@ def test_recovery_joins_pending_barrier_mid_training():
         assert time.time() - t0 < 2.0
     finally:
         srv.stop()
+
+
+def test_recovery_flag_expires_at_first_push(monkeypatch):
+    """The recovery flag covers only bring-up: after the first PUSH (real
+    training traffic), a later legitimate set_optimizer — the LR-drop-at-
+    epoch-boundary pattern — must install on the server instead of being
+    dropped as a recovery re-ship. Bring-up pulls must NOT expire it
+    (Module interleaves init/pull per parameter)."""
+    srv = kvs.start_server(num_workers=1)
+    try:
+        host, port = srv.addr
+        monkeypatch.setenv("DMLC_PS_ROOT_URI", host)
+        monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+        monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        monkeypatch.setenv("DMLC_IS_RECOVERY", "1")
+
+        # pre-existing live state from before the crash
+        boot = kvs.ServerClient(host, port)
+        boot.init("w", np.ones((2,), np.float32))
+        boot.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+        live_updater = srv.updater
+
+        kv = mx.kvstore.create("dist_async")
+        try:
+            assert kv._is_recovery
+            kv.init("w", mx.nd.ones((2,)))
+            out = mx.nd.zeros((2,))
+            kv.pull("w", out=out)  # bring-up pull: flag survives
+            assert kv._is_recovery
+            kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+            assert srv.updater is live_updater  # recovery re-ship dropped
+
+            kv.push("w", mx.nd.ones((2,)))  # training traffic
+            assert not kv._is_recovery
+            kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.01))
+            assert srv.updater is not live_updater  # LR drop installed
+        finally:
+            kv.close()
+    finally:
+        srv.stop()
