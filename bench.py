@@ -43,6 +43,9 @@ def main():
     ap.add_argument("--lm-hidden", type=int, default=2048)
     ap.add_argument("--lm-layers", type=int, default=6)
     ap.add_argument("--lm-batch", type=int, default=4)
+    ap.add_argument("--lm-attn", default="flash",
+                    choices=["flash", "splash"],
+                    help="attention backend for the LM metric (A/B)")
     cli = ap.parse_args()
 
     import jax
@@ -116,7 +119,9 @@ def main():
             lm = transformer_lm_bench(seq_len=cli.lm_seq_len,
                                       hidden=cli.lm_hidden,
                                       num_layers=cli.lm_layers,
-                                      batch_size=cli.lm_batch)
+                                      batch_size=cli.lm_batch,
+                                      attn_impl=cli.lm_attn)
+            record["transformer_lm_attn"] = cli.lm_attn
             record["transformer_lm_tokens_per_sec"] = round(
                 lm["tokens_per_sec"], 1)
             record["transformer_lm_tflops"] = round(lm["model_tflops"], 2)
@@ -130,9 +135,12 @@ def main():
 
 
 def transformer_lm_bench(seq_len=4096, hidden=2048, num_layers=6,
-                         batch_size=4, num_steps=10, warmup=2):
+                         batch_size=4, num_steps=10, warmup=2,
+                         attn_impl="flash"):
     """Model-level transformer-LM train-step benchmark through the Module
-    fused path (in-process; the TPU is held by this process)."""
+    fused path (in-process; the TPU is held by this process).
+    ``attn_impl``: "flash" (in-tree kernels) or "splash" (upstream) for
+    A/B at the model level."""
     import argparse as _ap
 
     from examples.transformer import train_lm
@@ -148,7 +156,8 @@ def transformer_lm_bench(seq_len=4096, hidden=2048, num_layers=6,
 
     net = mx.models.get_transformer_lm(
         vocab_size=args.vocab_size, num_layers=args.num_layers,
-        num_heads=args.num_heads, hidden=args.hidden, seq_len=args.seq_len)
+        num_heads=args.num_heads, hidden=args.hidden, seq_len=args.seq_len,
+        attn_impl=attn_impl)
     return train_lm.benchmark(args, net)
 
 

@@ -20,7 +20,8 @@ def _dense(x, n_in, n_out, name):
     return h
 
 
-def _block(x, hidden, num_heads, seq_len, name, block_q=512, block_k=512):
+def _block(x, hidden, num_heads, seq_len, name, block_q=512, block_k=512,
+           attn_impl="flash"):
     head_dim = hidden // num_heads
     # attention sublayer (pre-norm)
     h = sym.LayerNorm(x, name="%s_ln1" % name)
@@ -28,9 +29,18 @@ def _block(x, hidden, num_heads, seq_len, name, block_q=512, block_k=512):
     qkv = sym.Reshape(qkv, shape=(-1, seq_len, 3, num_heads, head_dim))
     q, k, v = sym.SliceChannel(qkv, num_outputs=3, axis=2, squeeze_axis=True,
                                name="%s_split" % name)
-    att = sym._contrib_FlashAttention(q, k, v, causal=True,
-                                      block_q=block_q, block_k=block_k,
-                                      name="%s_attn" % name)
+    if attn_impl == "splash":
+        # upstream splash kernel (ops/attention.py splash_attention) —
+        # the A/B alternative to the in-tree flash kernels
+        att = sym._contrib_SplashAttention(q, k, v, causal=True,
+                                           name="%s_attn" % name)
+    elif attn_impl == "flash":
+        att = sym._contrib_FlashAttention(q, k, v, causal=True,
+                                          block_q=block_q, block_k=block_k,
+                                          name="%s_attn" % name)
+    else:
+        raise ValueError("attn_impl must be 'flash' or 'splash', got %r"
+                         % (attn_impl,))
     att = sym.Reshape(att, shape=(-1, seq_len, hidden))
     proj = _dense(att, hidden, hidden, "%s_proj" % name)
     x = sym.broadcast_add(x, sym.Reshape(proj, shape=(-1, seq_len, hidden)),
@@ -45,9 +55,12 @@ def _block(x, hidden, num_heads, seq_len, name, block_q=512, block_k=512):
 
 
 def get_transformer_lm(vocab_size=32000, num_layers=4, num_heads=8,
-                       hidden=512, seq_len=128, block_q=512, block_k=512):
+                       hidden=512, seq_len=128, block_q=512, block_k=512,
+                       attn_impl="flash"):
     """Causal LM: data (b, seq_len) token ids -> SoftmaxOutput over the
-    vocab at every position (label (b*seq_len,) next-token ids)."""
+    vocab at every position (label (b*seq_len,) next-token ids).
+    ``attn_impl``: "flash" (in-tree Pallas kernels) or "splash"
+    (upstream jax splash attention)."""
     data = sym.Variable("data")
     pos = sym.Variable("pos_embed_weight", shape=(1, seq_len, hidden))
     x = sym.Embedding(data, input_dim=vocab_size, output_dim=hidden,
@@ -55,7 +68,7 @@ def get_transformer_lm(vocab_size=32000, num_layers=4, num_heads=8,
     x = sym.broadcast_add(x, pos, name="pos_add")
     for i in range(num_layers):
         x = _block(x, hidden, num_heads, seq_len, "layer%d" % i,
-                   block_q=block_q, block_k=block_k)
+                   block_q=block_q, block_k=block_k, attn_impl=attn_impl)
     x = sym.LayerNorm(x, name="ln_f")
     logits = _dense(x, hidden, vocab_size, "lm_head")  # (b*s, vocab)
     # label arrives (b, seq_len) from the iterator; flatten inside the

@@ -456,9 +456,52 @@ def _fa_bwd(attrs, res, ct):
                            interpret)
 
 
+def splash_attention(q, k, v, causal: bool = True, scale=None):
+    """Upstream splash-attention backend (jax.experimental.pallas.ops.tpu)
+    behind this framework's [b, seq, heads, d] layout — the mature,
+    internally-pipelined TPU kernel, offered as an alternative attention
+    implementation for A/B against the in-tree flash kernels (PERF.md's
+    ceiling reference). Interpret mode off-TPU, so CPU tests exercise the
+    real wrapper. Splash applies no logit scaling itself; q is pre-scaled
+    here, and gradients flow through splash's own custom vjp."""
+    import jax
+
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as _sk,
+        splash_attention_mask as _mk,
+    )
+
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    interpret = jax.default_backend() != "tpu"
+    mk_one = (_mk.CausalMask((s, s)) if causal
+              else _mk.FullMask((s, s)))
+    if s % 128:
+        # splash's lane constraint: every block dimension must be a
+        # multiple of 128 — shorter/odd sequences use the in-tree flash
+        # kernels (which clamp blocks to the sequence)
+        raise ValueError(
+            "splash_attention requires seq_len to be a multiple of 128 "
+            "(got %d); use the flash implementation instead" % s)
+    kern = _sk.make_splash_mha_single_device(
+        mask=_mk.MultiHeadMask([mk_one for _ in range(h)]),
+        interpret=interpret)
+    import jax.numpy as jnp
+
+    # scale in q's dtype: an np.float64 scalar would upcast bf16 q to
+    # f32 and break the kernel's matching-operand-dtype requirement
+    qt = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    o = jax.vmap(kern)(qt, kt, vt)
+    return o.transpose(0, 2, 1, 3)
+
+
 def _register():
     from .pallas_op import register_pallas_op
     from .param import Param
+    from .registry import register
 
     # dogfooding the public user-kernel API — mx.register_pallas_op IS how
     # this framework's own flash attention becomes an op (MXRtc parity,
@@ -472,6 +515,20 @@ def _register():
                 "block_k": Param(int, 512)},
         infer_shape=lambda attrs, s: (s, [s[0]], []),
         hint="flashattention")
+
+    # plain registration (no custom fwd/bwd): splash ships its own
+    # custom_vjp, so the executor's jax.vjp differentiates through it
+    @register("_contrib_SplashAttention",
+              inputs=("query", "key", "value"),
+              params={"causal": Param(bool, True),
+                      "scale": Param("float-or-none", None)},
+              infer_shape=lambda attrs, shapes: (shapes, [shapes[0]], []),
+              hint="splashattention")
+    def _splash_op(opctx, attrs, query, key, value):
+        scale = attrs.get("scale")
+        return splash_attention(query, key, value,
+                                causal=bool(attrs.get("causal", True)),
+                                scale=scale)
 
 
 _register()

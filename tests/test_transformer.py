@@ -92,3 +92,57 @@ def test_transformer_lm_learns_next_token():
         correct += (out.argmax(-1) == lab).sum()
         total += lab.size
     assert correct / total > 0.9, correct / total
+
+
+def test_splash_attention_op_matches_oracle():
+    """_contrib_SplashAttention (upstream splash kernel behind the op
+    registry, interpret mode on CPU): forward matches the dense oracle
+    and gradients flow through splash's own custom vjp in the executor."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel.ring import local_attention
+
+    rng = np.random.RandomState(1)
+    b, s, h, d = 1, 128, 2, 64
+    q, k, v = (rng.randn(b, s, h, d).astype(np.float32) * 0.3
+               for _ in range(3))
+    o = mx.nd._contrib_SplashAttention(mx.nd.array(q), mx.nd.array(k),
+                                       mx.nd.array(v))
+    ref = np.asarray(local_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), causal=True))
+    np.testing.assert_allclose(o.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+    net = mx.sym._contrib_SplashAttention(
+        mx.sym.Variable("q"), mx.sym.Variable("k"), mx.sym.Variable("v"))
+    ex = net.bind(mx.cpu(),
+                  {"q": mx.nd.array(q), "k": mx.nd.array(k),
+                   "v": mx.nd.array(v)},
+                  args_grad={n: mx.nd.zeros((b, s, h, d))
+                             for n in ("q", "k", "v")})
+    ex.forward(is_train=True)
+    head = rng.randn(b, s, h, d).astype(np.float32)
+    ex.backward(out_grads=[mx.nd.array(head)])
+    gq = jax.grad(lambda q: jnp.sum(local_attention(
+        q, jnp.asarray(k), jnp.asarray(v), causal=True)
+        * jnp.asarray(head)))(jnp.asarray(q))
+    np.testing.assert_allclose(ex.grad_dict["q"].asnumpy(), np.asarray(gq),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_transformer_lm_splash_impl_learns():
+    """The LM family's attn_impl='splash' A/B path trains through the
+    Module fused step (tiny synthetic next-token task)."""
+    rng = np.random.RandomState(0)
+    vocab, s, b = 16, 128, 4  # splash needs seq multiples of 128
+    X = rng.randint(0, vocab, size=(8 * b, s)).astype(np.float32)
+    Y = (X + 1) % vocab
+    it = mx.io.NDArrayIter(X, Y, batch_size=b, label_name="softmax_label")
+    net = mx.models.get_transformer_lm(
+        vocab_size=vocab, num_layers=1, num_heads=2, hidden=32,
+        seq_len=s, attn_impl="splash")
+    mod = mx.mod.Module(net, label_names=("softmax_label",))
+    metric = mx.metric.Perplexity(ignore_label=None)
+    mod.fit(it, num_epoch=8, optimizer="adam",
+            optimizer_params={"learning_rate": 5e-3}, eval_metric=metric)
+    assert metric.get()[1] < 8.0, metric.get()  # vocab/2 baseline ~16
