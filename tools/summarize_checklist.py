@@ -1,0 +1,65 @@
+"""Summarize a tpu_checklist JSONL run against the round's targets.
+
+Usage: python tools/summarize_checklist.py [TPU_CHECKLIST_r05.jsonl]
+Prints a PASS/FAIL table for the BASELINE.md two-track targets plus the
+hardware-validation checks, and the flash-vs-splash headroom.
+"""
+import json
+import sys
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else "TPU_CHECKLIST_r05.jsonl"
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    pass
+    by = {}
+    for r in rows:
+        by.setdefault(r.get("check", r.get("metric", "?")), []).append(r)
+
+    def got(name):
+        return by.get(name, [{}])[-1]
+
+    print("%-28s %-6s %s" % ("check", "ok", "detail"))
+    for name, entries in by.items():
+        for e in entries:
+            ok = e.get("ok", "-")
+            detail = {k: v for k, v in e.items() if k not in ("check", "ok")}
+            print("%-28s %-6s %s" % (name, ok, json.dumps(detail)[:110]))
+
+    # every target prints a verdict; a missing row is an explicit
+    # MISSING (a wedged run must not look like "nothing was in scope")
+    print("\n--- targets (BASELINE.md two-track) ---")
+    best = got("flash_train_best")
+    mfu = best.get("mfu")
+    print("flash kernel MFU: %s (target >=0.40; r4 best 0.243): %s"
+          % (mfu, "MISSING" if mfu is None
+             else ("PASS" if mfu >= 0.40 else "below")))
+    bench = got("resnet50_bench").get("result") or {}
+    v = bench.get("value")
+    print("resnet img/s: %s (roofline-parity target >=2400): %s"
+          % (v, "MISSING" if v is None
+             else ("PASS" if v >= 2400 else "below")))
+    lm = bench.get("transformer_lm_mfu")
+    print("transformer_lm_mfu: %s (target >=0.30; attn=%s): %s"
+          % (lm, bench.get("transformer_lm_attn"),
+             "MISSING" if lm is None
+             else ("PASS" if lm >= 0.30 else "below")))
+    orc = got("splash_oracle").get("result") or {}
+    ours, theirs = best.get("tflops"), orc.get("value")
+    if ours and theirs:
+        print("flash vs splash ceiling: %.1f / %.1f TFLOP/s (%.0f%%)"
+              % (ours, theirs, 100.0 * ours / theirs))
+    else:
+        print("flash vs splash ceiling: MISSING (ours=%s oracle=%s)"
+              % (ours, theirs))
+
+
+if __name__ == "__main__":
+    main()
