@@ -178,6 +178,23 @@ def main():
         except Exception as e:
             report("splash_oracle", ok=False, error=str(e)[:200])
 
+    # 7. model-level A/B: the transformer-LM train step on the splash
+    # backend (the flash-backend number is inside resnet50_bench's
+    # record); together with check 6 this closes the kernel-vs-model
+    # attribution question in one window
+    if not cli.skip_resnet and not cli.skip_oracle:
+        import bench
+
+        try:
+            with deadline(1200):
+                lm = bench.transformer_lm_bench(attn_impl="splash")
+            peak = 197e12
+            report("transformer_lm_splash",
+                   tokens_per_sec=round(lm["tokens_per_sec"], 1),
+                   mfu=round(lm["model_tflops"] * 1e12 / peak, 4), ok=True)
+        except Exception as e:
+            report("transformer_lm_splash", ok=False, error=str(e)[:200])
+
 
 if __name__ == "__main__":
     main()
