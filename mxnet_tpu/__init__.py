@@ -17,6 +17,34 @@ if _os.environ.get("JAX_PLATFORMS"):
     except Exception:  # backend already initialized — leave it be
         pass
 
+# Persistent XLA compilation cache: big fused-step programs (ResNet-50
+# fwd+bwd+update is ~30 min of XLA time on a 1-core host) survive process
+# restarts. MXNET_COMPILE_CACHE= (empty) disables; JAX_COMPILATION_CACHE_DIR
+# still wins if the user set it. Enabled when the PRIMARY (first-listed)
+# platform is TPU-shaped: XLA:CPU AOT cache entries embed host machine
+# features and can SIGILL on reload, so a cpu-primary config must not cache
+# (a cpu *fallback* entry is fine — it only compiles if the primary backend
+# failed to load at all). Unset JAX_PLATFORMS → off: the backend is unknown
+# until init and this image always pins the var.
+_plats = [p.strip() for p in
+          _os.environ.get("JAX_PLATFORMS", "").lower().split(",") if p.strip()]
+if ("JAX_COMPILATION_CACHE_DIR" not in _os.environ and _plats
+        and _plats[0] not in ("cpu", "cuda", "gpu", "rocm")):
+    _cache_dir = _os.environ.get(
+        "MXNET_COMPILE_CACHE",
+        _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      _os.pardir, ".jax_cache"))
+    if _cache_dir:
+        import jax as _jax
+
+        try:
+            _jax.config.update("jax_compilation_cache_dir",
+                               _os.path.abspath(_cache_dir))
+            _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                               2.0)
+        except Exception:
+            pass
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
