@@ -38,6 +38,25 @@ _LOG2E = 1.4426950408889634
 _LN2 = 0.6931471805599453
 
 
+def _use_exp2():
+    """MXTPU_FLASH_EXP2=0 reverts the softmax to natural-exp (A/B switch;
+    read at trace time so one process can benchmark both variants)."""
+    import os
+
+    return os.environ.get("MXTPU_FLASH_EXP2", "1") == "1"
+
+
+def _compiler_params(pltpu):
+    """Grid semantics hint (bh/q-tile parallel, stream dim sequential);
+    MXTPU_FLASH_DIMSEM=0 drops the hint entirely (A/B switch)."""
+    import os
+
+    if os.environ.get("MXTPU_FLASH_DIMSEM", "1") != "1":
+        return {}
+    return {"compiler_params": pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))}
+
+
 def _reference_attention(q, k, v, causal, scale):
     """Dense oracle — the single implementation lives in parallel.ring."""
     from ..parallel.ring import local_attention
@@ -70,7 +89,7 @@ def _pick_block(block, seq):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, bq, bk, nk, scale, causal):
+                *, bq, bk, nk, scale, causal, exp2):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -99,15 +118,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         vblk = v_ref[0]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) \
-            * (scale * _LOG2E)
+            * (scale * _LOG2E if exp2 else scale)
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG)
+        expf = jnp.exp2 if exp2 else jnp.exp
         m = m_scr[...]
         m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp2(s - m_new[:, None])
-        corr = jnp.exp2(m - m_new)
+        p = expf(s - m_new[:, None])
+        corr = expf(m - m_new)
         l_scr[...] = l_scr[...] * corr + p.sum(axis=-1)
         acc_scr[...] = acc_scr[...] * corr[:, None] + jax.lax.dot_general(
             p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
@@ -120,7 +140,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lsafe = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_scr[...] / lsafe[:, None]).astype(o_ref.dtype)
         # back to natural log at the boundary (ring/backward contract)
-        lse_ref[0, 0] = (m_scr[...] + jnp.log2(lsafe)) * _LN2
+        if exp2:
+            lse_ref[0, 0] = (m_scr[...] + jnp.log2(lsafe)) * _LN2
+        else:
+            lse_ref[0, 0] = m_scr[...] + jnp.log(lsafe)
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -140,7 +163,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, nk=nk,
-                               scale=scale, causal=causal)
+                               scale=scale, causal=causal, exp2=_use_exp2())
     # lse carries a singleton middle dim so its block's trailing dims
     # (1, bq) satisfy the Mosaic tiling rule (second-to-last equals the
     # array dim, last divisible by 128); squeezed before returning
@@ -173,9 +196,8 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         # bh and q-tile iterations are independent (parallel); the k
         # stream is the sequential dim carrying the softmax state — the
         # semantics let Mosaic overlap the K/V block DMAs with compute
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
+        **_compiler_params(pltpu),
     )(qt, kt, vt)
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse.reshape(b * h, sq)
 
@@ -186,7 +208,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_scr, *, bq, bk, nk, scale, causal):
+                   acc_scr, *, bq, bk, nk, scale, causal, exp2):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -210,14 +232,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) \
-            * (scale * _LOG2E)
+            * (scale * _LOG2E if exp2 else scale)
         if causal:
             q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG)
         # p is the same probability either way; only the exponential's
         # base changes (s and lse both carried in the base-2 domain)
-        p = jnp.exp2(s - (lse * _LOG2E)[:, None])
+        p = (jnp.exp2(s - (lse * _LOG2E)[:, None]) if exp2
+             else jnp.exp(s - lse[:, None]))
         dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - delta[:, None]) * scale).astype(kblk.dtype)
@@ -232,7 +255,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, bq, bk, nq, scale,
-                    causal):
+                    causal, exp2):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -257,12 +280,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) \
-            * (scale * _LOG2E)
+            * (scale * _LOG2E if exp2 else scale)
         if causal:
             q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG)
-        p = jnp.exp2(s - (lse * _LOG2E)[:, None])  # [bq, bk]
+        p = (jnp.exp2(s - (lse * _LOG2E)[:, None]) if exp2
+             else jnp.exp(s - lse[:, None]))  # [bq, bk]
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bk, d]
@@ -328,7 +352,7 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, nk=nk, scale=scale,
-                          causal=causal),
+                          causal=causal, exp2=_use_exp2()),
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),   # q
@@ -341,14 +365,13 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         out_specs=pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=sds((b * h, sq, d), q.dtype),
         scratch_shapes=[scratch((bq, d))],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
+        **_compiler_params(pltpu),
     )(qt, kt, vt, dot, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, bq=bq, bk=bk, nq=nq, scale=scale,
-                          causal=causal),
+                          causal=causal, exp2=_use_exp2()),
         grid=(b * h, nk, nq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),   # q
@@ -365,9 +388,8 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
         out_shape=[sds((b * h, sk, d), k.dtype),
                    sds((b * h, sk, d), v.dtype)],
         scratch_shapes=[scratch((bk, d)), scratch((bk, d))],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
+        **_compiler_params(pltpu),
     )(qt, kt, vt, dot, lse3, delta3)
 
     unflat = lambda t, s: t.reshape(b, h, s, d).transpose(0, 2, 1, 3)

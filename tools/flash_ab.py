@@ -1,0 +1,67 @@
+"""A/B the round-5 flash-kernel changes on hardware.
+
+Round 4 measured 47.9 TFLOP/s at (bq=512, bk=1024) BEFORE the exp2 +
+dimension_semantics commit; the round-5 checklist measured 12.6 at the
+same blocks AFTER it. This sweeps the 2x2 variant grid through the same
+run_bench harness to attribute the regression.
+
+Usage: python tools/flash_ab.py [--seq 8192] [--steps 10]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=8192)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--blocks", default="512x1024,1024x512,512x512")
+    cli = ap.parse_args()
+
+    import contextlib
+    import signal
+
+    from bench_attention import run_bench
+
+    @contextlib.contextmanager
+    def deadline(seconds):
+        def _raise(sig, frm):
+            raise TimeoutError("deadline %ds" % seconds)
+
+        old = signal.signal(signal.SIGALRM, _raise)
+        signal.alarm(seconds)
+        try:
+            yield
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+
+    blocks = [tuple(int(x) for x in bl.split("x"))
+              for bl in cli.blocks.split(",")]
+    for exp2 in ("1", "0"):
+        for dimsem in ("1", "0"):
+            os.environ["MXTPU_FLASH_EXP2"] = exp2
+            os.environ["MXTPU_FLASH_DIMSEM"] = dimsem
+            for bq, bk in blocks:
+                try:
+                    with deadline(600):
+                        r = run_bench(seq=cli.seq, steps=cli.steps,
+                                      block_q=bq, block_k=bk)
+                    print(json.dumps({"exp2": exp2, "dimsem": dimsem,
+                                      "bq": bq, "bk": bk,
+                                      "tflops": r["value"],
+                                      "step_ms": r["step_ms"],
+                                      "mfu": r["mfu"]}), flush=True)
+                except Exception as e:
+                    print(json.dumps({"exp2": exp2, "dimsem": dimsem,
+                                      "bq": bq, "bk": bk,
+                                      "error": str(e)[:160]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

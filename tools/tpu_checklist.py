@@ -157,7 +157,11 @@ def main():
         # bench.py's duplicate secondary metric
         sys.argv = ["bench.py", "--skip-attention"]
         try:
-            with deadline(3000):
+            # the ResNet fused step is ~30min of cold XLA compile on a
+            # 1-core host (cached in .jax_cache afterwards); 3000s raced
+            # the cold compile and aborted AFTER paying for it but BEFORE
+            # the cache write
+            with deadline(5400):
                 rec = bench.main()
             report("resnet50_bench", result=rec,
                    ok=bool(rec) and "error" not in rec)
