@@ -39,19 +39,25 @@ _LN2 = 0.6931471805599453
 
 
 def _use_exp2():
-    """MXTPU_FLASH_EXP2=0 reverts the softmax to natural-exp (A/B switch;
-    read at trace time so one process can benchmark both variants)."""
+    """MXTPU_FLASH_EXP2=0 reverts the softmax to natural-exp (A/B switch).
+    Read at TRACE time: an already-jitted step keeps the variant it was
+    traced with — rebuild the jit (as tools/flash_ab.py's harness does per
+    run) for a flip to take effect."""
     import os
 
     return os.environ.get("MXTPU_FLASH_EXP2", "1") == "1"
 
 
 def _compiler_params(pltpu):
-    """Grid semantics hint (bh/q-tile parallel, stream dim sequential);
-    MXTPU_FLASH_DIMSEM=0 drops the hint entirely (A/B switch)."""
+    """Grid semantics hint (bh/q-tile parallel, stream dim sequential),
+    OFF by default: measured on v5e (tools/flash_ab.py, s=8k d=128), the
+    hint made the train step ~40% slower and run-to-run erratic when
+    combined with the exp2 softmax (20.7 vs 34.3 TFLOP/s at bq=512
+    bk=1024); Mosaic's default sequential pipelining double-buffers the
+    streamed blocks fine on its own. MXTPU_FLASH_DIMSEM=1 re-enables."""
     import os
 
-    if os.environ.get("MXTPU_FLASH_DIMSEM", "1") != "1":
+    if os.environ.get("MXTPU_FLASH_DIMSEM", "0") != "1":
         return {}
     return {"compiler_params": pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))}
@@ -333,6 +339,7 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     bq = _pick_block(block_q, sq)
     bk = _pick_block(block_k, sk)
     nq, nk = sq // bq, sk // bk
+    exp2 = _use_exp2()  # one read: dq and dk/dv kernels share the variant
     if pre is None:
         pre = _flash_bwd_precompute(q, o, lse, do)
     qt, dot, lse3, delta3 = pre
@@ -352,7 +359,7 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, nk=nk, scale=scale,
-                          causal=causal, exp2=_use_exp2()),
+                          causal=causal, exp2=exp2),
         grid=(b * h, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),   # q
@@ -371,7 +378,7 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, bq=bq, bk=bk, nq=nq, scale=scale,
-                          causal=causal, exp2=_use_exp2()),
+                          causal=causal, exp2=exp2),
         grid=(b * h, nk, nq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0)),   # q

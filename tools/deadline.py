@@ -1,0 +1,24 @@
+"""Shared SIGALRM deadline for the chip-facing tools.
+
+CAVEAT (load-bearing): SIGALRM raises only when control returns to
+Python — a hang INSIDE a native XLA compile/execute call is not
+interrupted; the TimeoutError fires as soon as the native call returns.
+For a truly wedged native call, wrap the whole tool in coreutils
+``timeout`` instead.
+"""
+import contextlib
+import signal
+
+
+@contextlib.contextmanager
+def deadline(seconds):
+    def _raise(sig, frm):
+        raise TimeoutError("exceeded %ds" % seconds)
+
+    old = signal.signal(signal.SIGALRM, _raise)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)

@@ -23,23 +23,8 @@ def main():
     ap.add_argument("--blocks", default="512x1024,1024x512,512x512")
     cli = ap.parse_args()
 
-    import contextlib
-    import signal
-
     from bench_attention import run_bench
-
-    @contextlib.contextmanager
-    def deadline(seconds):
-        def _raise(sig, frm):
-            raise TimeoutError("deadline %ds" % seconds)
-
-        old = signal.signal(signal.SIGALRM, _raise)
-        signal.alarm(seconds)
-        try:
-            yield
-        finally:
-            signal.alarm(0)
-            signal.signal(signal.SIGALRM, old)
+    from deadline import deadline
 
     blocks = [tuple(int(x) for x in bl.split("x"))
               for bl in cli.blocks.split(",")]

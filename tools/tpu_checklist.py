@@ -73,28 +73,8 @@ def main():
     # 2. throughput ladder at --seq, swept over block shapes (in-process;
     # the chip belongs to this process)
     sys.path.insert(0, os.path.join(ROOT, "tools"))
-    import contextlib
-    import signal
-
     from bench_attention import run_bench
-
-    @contextlib.contextmanager
-    def deadline(seconds):
-        # bounds interpreter-level stalls (slow loops, retry spins); a hang
-        # INSIDE a native XLA call cannot be interrupted in-process — the
-        # alarm fires but the handler runs only when control returns to the
-        # interpreter, so wrap the whole checklist in an external `timeout`
-        # when validating a chip suspected of wedging in compilation
-        def _raise(signum, frame):
-            raise TimeoutError("exceeded %ds" % seconds)
-
-        old = signal.signal(signal.SIGALRM, _raise)
-        signal.alarm(seconds)
-        try:
-            yield
-        finally:
-            signal.alarm(0)
-            signal.signal(signal.SIGALRM, old)
+    from deadline import deadline
 
     best = None
     for bq, bk in ((512, 512), (512, 1024), (1024, 512)):
