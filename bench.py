@@ -1,23 +1,44 @@
-"""Headline benchmark: ResNet-50 training throughput on one chip, measured
-through the REAL framework path — Module.bind/init_optimizer +
-forward_backward/update/update_metric, i.e. exactly what
+"""Headline benchmark, wedge-resistant two-phase orchestration.
+
+Phase LM (the headline, VERDICT r4 #2): model-level transformer-LM
+train-step MFU (seq 4096, bf16, adam) through the REAL framework path —
+Module.bind/init_optimizer + forward_backward/update — plus the flash
+kernel secondary. Small program, compiles in minutes (and hits the
+persistent .jax_cache after the first chip session).
+
+Phase ResNet (the parity track): ResNet-50 training throughput through
+the same Module path, i.e. exactly what
 ``examples/image_classification/train_imagenet.py --benchmark 1`` runs.
-
 Reference equivalent: example/image-classification/train_imagenet.py with
-``--benchmark 1`` (synthetic data, common/fit.py:106-116); reference baseline
-is 181.53 img/s on 1x P100 (docs/how_to/perf.md:130-139).
+``--benchmark 1`` (synthetic data, common/fit.py:106-116); reference
+baseline 181.53 img/s on 1x P100 (docs/how_to/perf.md:130-139). Its
+fused fwd+bwd+update program is ~60-90min of cold XLA compile on a
+1-core host (minutes once .jax_cache is warm).
 
-The hot loop is ONE fused, donated XLA program per step (Executor.fused_step:
-forward + backward + SGD-momentum update; bf16 compute, f32 master params).
-Prints ONE JSON line with img/s and MFU.
+Run as ``python bench.py`` each phase executes in its own SUBPROCESS
+with a hard timeout — a wedged compile/backend (the BENCH_r04 failure
+mode: rc=1, 0.0 img/s, chip unreachable) is killed instead of taking
+the whole bench down, and a provisional headline line is printed as
+soon as the LM phase lands so even a mid-ResNet kill leaves a parsable
+result. The LAST JSON line on stdout is the record of note.
+
+``python bench.py --in-process`` (or ``bench.main()``, used by
+tools/tpu_checklist.py which already holds the chip) keeps everything
+in one process: a subprocess could not claim the TPU from a parent
+that owns it.
 """
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# BASELINE.md two-track targets of record (model-level transformer MFU)
+LM_ROUND_TARGET = 0.30
+LM_NORTH_STAR = 0.40
 
 
 def _peak_flops(backend):
@@ -27,7 +48,7 @@ def _peak_flops(backend):
     return 0.0
 
 
-def main():
+def _arg_parser():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=None)
     ap.add_argument("--num-steps", type=int, default=30)
@@ -46,10 +67,22 @@ def main():
     ap.add_argument("--lm-attn", default="flash",
                     choices=["flash", "splash"],
                     help="attention backend for the LM metric (A/B)")
-    cli = ap.parse_args()
+    ap.add_argument("--in-process", action="store_true",
+                    help="single-process mode (for callers already "
+                         "holding the TPU); default CLI orchestrates "
+                         "subprocess phases with hard timeouts")
+    ap.add_argument("--phase", choices=["resnet", "lm"], default=None,
+                    help="internal: run one phase and print its record")
+    ap.add_argument("--resnet-timeout", type=int, default=6600,
+                    help="seconds before the ResNet subprocess is killed")
+    ap.add_argument("--lm-timeout", type=int, default=2400,
+                    help="seconds before the LM subprocess is killed")
+    return ap
 
+
+def resnet_bench(cli):
+    """ResNet-50 Module-path record (the r1-r4 headline)."""
     import jax
-    import numpy as np
 
     from examples.image_classification.common import fit
     from examples.image_classification.train_imagenet import get_network
@@ -70,18 +103,16 @@ def main():
     stats = fit.benchmark(args, net, num_steps=steps, warmup=warmup)
 
     if not stats.get("finite", True):
-        record = {"metric": "resnet50_train_throughput", "value": 0.0,
-                  "unit": "img/s", "vs_baseline": 0.0,
-                  "error": "non-finite parameters after training"}
-        print(json.dumps(record))
-        return record
+        return {"metric": "resnet50_train_throughput", "value": 0.0,
+                "unit": "img/s", "vs_baseline": 0.0,
+                "error": "non-finite parameters after training"}
 
     img_per_sec = stats["img_per_sec"]
     # ResNet-50 fwd ~= 4.09 GFLOP/img at 224x224; train ~= 3x fwd
     model_flops = 3 * 4.089e9
     peak = _peak_flops(backend)
     mfu = (img_per_sec * model_flops / peak) if peak else None
-    record = {
+    return {
         "metric": "resnet50_train_throughput",
         "value": round(img_per_sec, 2),
         "unit": "img/s",
@@ -93,45 +124,37 @@ def main():
         "mfu": round(mfu, 4) if mfu is not None else None,
         "path": "module",
     }
-    if backend == "tpu" and not cli.skip_attention:
-        # secondary metric: the high-MFU path (flash-attention train step;
-        # PERF.md's transformer story). In-process — the TPU is held by
-        # this process, a subprocess could not claim it. Never allowed to
-        # break the headline.
-        try:
-            tools_dir = os.path.join(
-                os.path.dirname(os.path.abspath(__file__)), "tools")
-            if tools_dir not in sys.path:
-                sys.path.insert(0, tools_dir)
-            from bench_attention import run_bench
 
-            att = run_bench(seq=8192, steps=5)
-            record["flash_attention_tflops"] = att["value"]
-            record["flash_attention_mfu"] = att["mfu"]
-        except Exception as e:
-            print("flash-attention secondary bench failed: %r" % (e,),
-                  file=sys.stderr)
-    if backend == "tpu" and not cli.skip_transformer:
-        # first-class MODEL-level metric: transformer-LM train step (seq 4k,
-        # bf16, Module fused path) — the framework-level MFU story, not
-        # just the attention kernel (examples/transformer/train_lm.py).
-        try:
-            lm = transformer_lm_bench(seq_len=cli.lm_seq_len,
-                                      hidden=cli.lm_hidden,
-                                      num_layers=cli.lm_layers,
-                                      batch_size=cli.lm_batch,
-                                      attn_impl=cli.lm_attn)
-            record["transformer_lm_attn"] = cli.lm_attn
-            record["transformer_lm_tokens_per_sec"] = round(
-                lm["tokens_per_sec"], 1)
-            record["transformer_lm_tflops"] = round(lm["model_tflops"], 2)
-            record["transformer_lm_mfu"] = round(
-                lm["model_tflops"] * 1e12 / _peak_flops(backend), 4)
-        except Exception as e:
-            print("transformer-LM secondary bench failed: %r" % (e,),
-                  file=sys.stderr)
-    print(json.dumps(record))
-    return record
+
+def _flash_kernel_fields(record):
+    """Secondary metric: the flash-attention kernel train step."""
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from bench_attention import run_bench
+
+    att = run_bench(seq=8192, steps=5, block_q=512, block_k=1024)
+    record["flash_attention_tflops"] = att["value"]
+    record["flash_attention_mfu"] = att["mfu"]
+
+
+def _lm_fields(record, cli):
+    """First-class MODEL-level metric: transformer-LM train step (seq 4k,
+    bf16, Module fused path) — the framework-level MFU story, not just
+    the attention kernel (examples/transformer/train_lm.py)."""
+    lm = transformer_lm_bench(seq_len=cli.lm_seq_len,
+                              hidden=cli.lm_hidden,
+                              num_layers=cli.lm_layers,
+                              batch_size=cli.lm_batch,
+                              attn_impl=cli.lm_attn)
+    record["transformer_lm_attn"] = cli.lm_attn
+    record["transformer_lm_tokens_per_sec"] = round(
+        lm["tokens_per_sec"], 1)
+    record["transformer_lm_step_ms"] = round(lm["step_time_ms"], 1)
+    record["transformer_lm_tflops"] = round(lm["model_tflops"], 2)
+    record["transformer_lm_mfu"] = round(
+        lm["model_tflops"] * 1e12 / _peak_flops("tpu"), 4)
 
 
 def transformer_lm_bench(seq_len=4096, hidden=2048, num_layers=6,
@@ -161,12 +184,209 @@ def transformer_lm_bench(seq_len=4096, hidden=2048, num_layers=6,
     return train_lm.benchmark(args, net)
 
 
+def _headline(record):
+    """Shape the final one-line JSON. The model-level transformer-LM MFU
+    is the headline when measured (BASELINE.md two-track table: model
+    >=30% this round, >=40% standing); the ResNet record stays embedded
+    (and is the fallback headline when the LM number is absent)."""
+    if record.get("transformer_lm_mfu"):
+        out = {"metric": "transformer_lm_train_mfu",
+               "value": record["transformer_lm_mfu"],
+               "unit": "MFU",
+               "vs_baseline": round(
+                   record["transformer_lm_mfu"] / LM_NORTH_STAR, 3),
+               "round_target": LM_ROUND_TARGET,
+               "north_star": LM_NORTH_STAR}
+        for k, v in record.items():
+            if k not in ("metric", "value", "unit", "vs_baseline"):
+                out[k] = v
+        # keep the parity track visible at the top level
+        if record.get("metric") == "resnet50_train_throughput":
+            out["resnet50_img_per_sec"] = record.get("value")
+            out["resnet50_vs_p100"] = record.get("vs_baseline")
+        return out
+    return record
+
+
+def main(argv=None):
+    """Single-process bench (the pre-r5 behavior): ResNet first, then the
+    flash kernel + transformer-LM secondaries. Used by tpu_checklist
+    (the chip belongs to that process) and ``--in-process``."""
+    cli = _arg_parser().parse_args(argv)
+
+    record = resnet_bench(cli)
+    if "error" in record:
+        print(json.dumps(record))
+        return record
+    backend = record.get("backend")
+    if backend == "tpu" and not cli.skip_attention:
+        # Never allowed to break the headline.
+        try:
+            _flash_kernel_fields(record)
+        except Exception as e:
+            print("flash-attention secondary bench failed: %r" % (e,),
+                  file=sys.stderr)
+    if backend == "tpu" and not cli.skip_transformer:
+        try:
+            _lm_fields(record, cli)
+        except Exception as e:
+            print("transformer-LM secondary bench failed: %r" % (e,),
+                  file=sys.stderr)
+    # keep the resnet-shaped record (metric/value = img/s) — the
+    # checklist summarizer scores this shape; only the orchestrated CLI
+    # reshapes the headline via _headline()
+    print(json.dumps(record))
+    return record
+
+
+def _phase(cli):
+    """Run one phase in THIS process and print its partial record."""
+    record = {}
+    if cli.phase == "resnet":
+        record = resnet_bench(cli)
+        # when the lm phase is skipped entirely, the flash kernel
+        # secondary still belongs somewhere — run it here
+        if (record.get("backend") == "tpu" and cli.skip_transformer
+                and not cli.skip_attention and "error" not in record):
+            try:
+                _flash_kernel_fields(record)
+            except Exception as e:
+                print("flash kernel secondary failed: %r" % (e,),
+                      file=sys.stderr)
+    else:
+        import mxnet_tpu  # noqa: F401  (applies JAX_PLATFORMS before
+        # backend init — the image pins jax_platforms="axon,cpu" and the
+        # axon client hangs on a dead tunnel even when cpu is requested)
+        import jax
+
+        record["backend"] = jax.default_backend()
+        if record["backend"] != "tpu":
+            record["lm_skipped"] = "backend %s" % record["backend"]
+        else:
+            _lm_fields(record, cli)
+            if not cli.skip_attention:
+                try:
+                    _flash_kernel_fields(record)
+                except Exception as e:
+                    print("flash kernel secondary failed: %r" % (e,),
+                          file=sys.stderr)
+    print(json.dumps(record))
+    return record
+
+
+def _run_phase(phase, cli, timeout):
+    """Run ``bench.py --phase <phase>`` as a subprocess with a HARD
+    timeout (SIGKILL reaches a wedge inside a native XLA call, which an
+    in-process SIGALRM cannot — the BENCH_r04 lesson). Returns the
+    phase's record dict, or an {"..._error": msg} dict."""
+    passthrough = ["--phase", phase,
+                   "--num-steps", str(cli.num_steps),
+                   "--warmup", str(cli.warmup),
+                   "--lr", str(cli.lr), "--dtype", cli.dtype,
+                   "--lm-seq-len", str(cli.lm_seq_len),
+                   "--lm-hidden", str(cli.lm_hidden),
+                   "--lm-layers", str(cli.lm_layers),
+                   "--lm-batch", str(cli.lm_batch),
+                   "--lm-attn", cli.lm_attn]
+    if cli.batch_size:
+        passthrough += ["--batch-size", str(cli.batch_size)]
+    if cli.skip_attention:
+        passthrough += ["--skip-attention"]
+    if cli.skip_transformer:
+        passthrough += ["--skip-transformer"]
+    err_key = "%s_error" % phase
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + passthrough,
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {err_key: "phase killed after %ds (wedged compile or "
+                         "unreachable TPU backend)" % timeout}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "error" in rec:
+            # normalize any child-side failure (including the __main__
+            # fallback JSON, which carries metric/value keys that must
+            # not contaminate the merged record) to one error field
+            return {err_key: str(rec["error"])[:300]}
+        return rec
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return {err_key: "rc=%d %s" % (proc.returncode,
+                                   "; ".join(tail[-2:])[:300])}
+
+
+def orchestrate(argv=None):
+    """Default CLI path: LM phase first (fast; provisional headline line
+    printed immediately), then the ResNet phase, then the merged record.
+    The driver parses the LAST JSON line, so a kill at any point after
+    the LM phase still leaves a scored result."""
+    cli = _arg_parser().parse_args(argv)
+    record = {}
+
+    # cheap liveness probe: a dead/wedged TPU tunnel (the BENCH_r04
+    # failure mode) should cost 5 minutes, not the sum of both phase
+    # timeouts. The probe claims and releases the chip before phase 1.
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import mxnet_tpu, jax; d = jax.devices();"
+             "x = jax.numpy.ones((8, 8)); (x @ x).block_until_ready();"
+             "print('probe-ok', d)"],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if "probe-ok" not in probe.stdout:
+            raise RuntimeError((probe.stderr or probe.stdout)
+                               .strip().splitlines()[-1][:200]
+                               if (probe.stderr or probe.stdout).strip()
+                               else "no output")
+    except (subprocess.TimeoutExpired, RuntimeError) as e:
+        msg = ("backend probe failed (unreachable TPU tunnel?): %s"
+               % (e,))[:300]
+        record = {"metric": "transformer_lm_train_mfu", "value": 0.0,
+                  "unit": "MFU", "vs_baseline": 0.0, "error": msg}
+        print(json.dumps(record))
+        return record
+
+    if not cli.skip_transformer:
+        record.update(_run_phase("lm", cli, cli.lm_timeout))
+        if record.get("transformer_lm_mfu"):
+            print(json.dumps(_headline(dict(record))), flush=True)
+
+    resnet = _run_phase("resnet", cli, cli.resnet_timeout)
+    metric_fields = {k: resnet.pop(k, None) for k in
+                     ("metric", "value", "unit", "vs_baseline")}
+    record.update({k: v for k, v in resnet.items() if v is not None})
+    if metric_fields.get("metric"):
+        record.update({k: v for k, v in metric_fields.items()
+                       if v is not None})
+
+    record = _headline(record)
+    if "value" not in record:  # both phases failed
+        record = {"metric": "transformer_lm_train_mfu", "value": 0.0,
+                  "unit": "MFU", "vs_baseline": 0.0,
+                  "error": "; ".join(str(record[k]) for k in record
+                                     if k.endswith("_error"))[:300]}
+    print(json.dumps(record))
+    return record
+
+
 if __name__ == "__main__":
     try:
-        main()
+        if "--phase" in sys.argv:
+            _phase(_arg_parser().parse_args())
+        elif "--in-process" in sys.argv:
+            main()
+        else:
+            rec = orchestrate()
+            if "error" in rec:
+                sys.exit(1)
     except Exception as e:  # emit the one JSON line even on failure
-        print(json.dumps({"metric": "resnet50_train_throughput",
-                          "value": 0.0, "unit": "img/s",
+        print(json.dumps({"metric": "transformer_lm_train_mfu",
+                          "value": 0.0, "unit": "MFU",
                           "vs_baseline": 0.0,
                           "error": "%s: %s" % (type(e).__name__,
                                                str(e)[:300])}))
