@@ -170,6 +170,16 @@ def main():
         import bench
 
         try:
+            # a TimeoutError raised mid-dispatch in an earlier check can
+            # leave the backend resolution wedged (observed 2026-07-31:
+            # pallas lowered "for CPU" on a TPU-only process after the
+            # check-5 alarm fired inside a native compile) — re-assert
+            # before attributing a failure to the kernel under test
+            if jax.default_backend() != "tpu":
+                raise RuntimeError(
+                    "backend no longer reports tpu (%s) — wedged by an "
+                    "earlier check's timeout; rerun standalone"
+                    % jax.default_backend())
             with deadline(1200):
                 lm = bench.transformer_lm_bench(attn_impl="splash")
             peak = 197e12
