@@ -60,6 +60,7 @@ from . import symbol as sym
 from .symbol import Symbol
 from . import executor
 from .executor import Executor
+from . import filesystem
 from . import io
 from . import recordio
 from . import initializer

@@ -313,9 +313,26 @@ class ImageIter(mxio.DataIter):
         self._native_prefetch = None
         self._rec_path = path_imgrec
         if path_imgrec:
+            from .filesystem import scheme_of
+
             idx_path = kwargs.get("path_imgidx",
                                   os.path.splitext(path_imgrec)[0] + ".idx")
-            if os.path.exists(idx_path):
+            # remote schemes have no os.path.exists; probe by opening
+            # (an explicitly passed remote path_imgidx must not be
+            # silently ignored)
+            if scheme_of(idx_path) in ("", "file"):
+                have_idx = os.path.exists(
+                    idx_path[7:] if scheme_of(idx_path) == "file"
+                    else idx_path)
+            else:
+                from .filesystem import open_uri
+
+                try:
+                    open_uri(idx_path, "r").close()
+                    have_idx = True
+                except Exception:
+                    have_idx = "path_imgidx" in kwargs
+            if have_idx:
                 self.record = recordio.MXIndexedRecordIO(idx_path,
                                                          path_imgrec, "r")
                 self.seq = list(self.record.keys)
@@ -383,10 +400,17 @@ class ImageIter(mxio.DataIter):
         return self._provide_label
 
     def reset(self):
+        from .filesystem import scheme_of
+
         if self.shuffle and self.seq is not None:
             pyrandom.shuffle(self.seq)
+        # the C++ fast path mmap/reads a local file; registered remote
+        # schemes (mx.filesystem) stay on the Python handle, which
+        # already resolved through the registry
+        native_ok = native.have_native() and \
+            scheme_of(self._rec_path or "") == ""
         if self.record is not None and self.seq is None:
-            if native.have_native():
+            if native_ok:
                 # C++ readahead thread (src/recordio.cc prefetcher) for the
                 # sequential scan; Python handle untouched
                 if self._native_prefetch is not None:
@@ -396,7 +420,7 @@ class ImageIter(mxio.DataIter):
                     self._rec_path)
             else:
                 self.record.reset()
-        elif self.record is not None and native.have_native() and \
+        elif self.record is not None and native_ok and \
                 self._native_reader is None:
             self._native_reader = native.NativeRecordReader(self._rec_path)
         self.cur = 0

@@ -38,11 +38,13 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        from .filesystem import open_uri
+
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
+            self.handle = open_uri(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
+            self.handle = open_uri(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
@@ -156,13 +158,15 @@ class MXIndexedRecordIO(MXRecordIO):
         super().__init__(uri, flag)
 
     def open(self):
+        from .filesystem import open_uri
+
         super().open()
         self.idx = {}
         self.keys = []
         if self.writable:
-            self.fidx = open(self.idx_path, "w")
+            self.fidx = open_uri(self.idx_path, "w")
         else:
-            self.fidx = open(self.idx_path, "r")
+            self.fidx = open_uri(self.idx_path, "r")
             for line in iter(self.fidx.readline, ""):
                 line = line.strip().split("\t")
                 key = self.key_type(line[0])
