@@ -41,10 +41,30 @@ def scheme_of(uri: str) -> str:
     return head.lower()
 
 
+def local_path(uri: str):
+    """The local filesystem path for ''/file:// URIs, else None. The ONE
+    place local-vs-remote resolution lives — callers that need an
+    existence check use this rather than re-deriving the rule."""
+    scheme = scheme_of(uri)
+    if scheme == "":
+        return uri
+    if scheme == "file":
+        return uri[7:]
+    return None
+
+
 def register_scheme(scheme: str, opener: Callable) -> None:
     """Register ``opener(uri, mode) -> file-like`` for ``scheme``.
     Re-registering replaces (last wins); ``None`` unregisters."""
     scheme = scheme.lower().rstrip(":")
+    if scheme in ("", "file") or len(scheme) == 1:
+        # '' / 'file' are built-in local; single letters are treated as
+        # Windows drive prefixes by scheme_of — an opener registered
+        # under any of these would never be dispatched
+        raise ValueError(
+            "scheme %r cannot be registered: ''/file are built-in local "
+            "and single-letter schemes collide with drive letters"
+            % scheme)
     if opener is None:
         _SCHEMES.pop(scheme, None)
     else:
@@ -53,9 +73,10 @@ def register_scheme(scheme: str, opener: Callable) -> None:
 
 def open_uri(uri: str, mode: str = "rb"):
     """Open ``uri`` through the scheme registry (local files built in)."""
+    lp = local_path(uri)
+    if lp is not None:
+        return open(lp, mode)
     scheme = scheme_of(uri)
-    if scheme in ("", "file"):
-        return open(uri[7:] if scheme == "file" else uri, mode)
     opener = _SCHEMES.get(scheme)
     if opener is None:
         hint = (" (the reference gates %s:// behind USE_%s at build "

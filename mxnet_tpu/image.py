@@ -313,28 +313,24 @@ class ImageIter(mxio.DataIter):
         self._native_prefetch = None
         self._rec_path = path_imgrec
         if path_imgrec:
-            from .filesystem import scheme_of
+            from .filesystem import local_path
 
             idx_path = kwargs.get("path_imgidx",
                                   os.path.splitext(path_imgrec)[0] + ".idx")
-            # remote schemes have no os.path.exists; probe by opening
-            # (an explicitly passed remote path_imgidx must not be
-            # silently ignored)
-            if scheme_of(idx_path) in ("", "file"):
-                have_idx = os.path.exists(
-                    idx_path[7:] if scheme_of(idx_path) == "file"
-                    else idx_path)
-            else:
-                from .filesystem import open_uri
-
+            lp = local_path(idx_path)
+            # local: cheap existence check; remote: attempt the indexed
+            # open and fall back only on not-found (auth/transport
+            # errors stay LOUD, and an explicitly passed path_imgidx is
+            # never silently discarded)
+            if lp is None or os.path.exists(lp):
                 try:
-                    open_uri(idx_path, "r").close()
-                    have_idx = True
-                except Exception:
-                    have_idx = "path_imgidx" in kwargs
-            if have_idx:
-                self.record = recordio.MXIndexedRecordIO(idx_path,
-                                                         path_imgrec, "r")
+                    self.record = recordio.MXIndexedRecordIO(
+                        idx_path, path_imgrec, "r")
+                except (FileNotFoundError, KeyError, IsADirectoryError):
+                    if "path_imgidx" in kwargs:
+                        raise
+                    self.record = None
+            if self.record is not None:
                 self.seq = list(self.record.keys)
             else:
                 self.record = recordio.MXRecordIO(path_imgrec, "r")

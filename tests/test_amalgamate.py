@@ -1,0 +1,55 @@
+"""The single-artifact predict bundle (amalgamation parity — reference
+amalgamation/README.md:1-14): build the .pyz, run it in a clean
+subprocess against a trained checkpoint, match in-process outputs."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_pyz_predicts_like_in_process(tmp_path):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import amalgamate
+
+    # tiny trained model -> checkpoint
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=3), name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.randn(48, 10).astype("f")
+    Y = (X[:, 0] > 0).astype("f") + (X[:, 1] > 0)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16)
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=4, optimizer_params={"learning_rate": 0.3})
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 4)
+
+    # in-process prediction
+    pred = mx.predictor.Predictor.from_checkpoint(prefix, 4,
+                                                  {"data": (8, 10)})
+    pred.forward(data=X[:8])
+    want = pred.get_output(0)
+    want = want.asnumpy() if hasattr(want, "asnumpy") else np.asarray(want)
+
+    # bundle + subprocess prediction
+    pyz = amalgamate.build(str(tmp_path / "mxtpu_predict.pyz"))
+    assert os.path.getsize(pyz) > 10000
+    np.save(str(tmp_path / "x.npy"), X[:8])
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONPATH", None)  # the bundle must be self-contained
+    proc = subprocess.run(
+        [sys.executable, pyz, "--prefix", prefix, "--epoch", "4",
+         "--input", str(tmp_path / "x.npy"),
+         "--output", str(tmp_path / "out.npy")],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr[-800:]
+    got = np.load(str(tmp_path / "out.npy"))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+    # stdout: topk lines, one per row
+    assert len(proc.stdout.strip().splitlines()) == 8

@@ -39,6 +39,12 @@ def test_unregistered_scheme_raises_with_hint():
         mx.filesystem.open_uri("weird://x/y", "rb")
 
 
+def test_non_dispatchable_schemes_rejected():
+    for bad in ("", "file", "a"):
+        with pytest.raises(ValueError, match="cannot be registered"):
+            mx.filesystem.register_scheme(bad, lambda uri, mode: None)
+
+
 def test_plain_and_file_paths_are_local(tmp_path):
     p = tmp_path / "x.bin"
     with mx.filesystem.open_uri(str(p), "wb") as f:

@@ -1,0 +1,104 @@
+"""Single-artifact predict bundle — the amalgamation story, TPU-era.
+
+Reference: ``amalgamation/`` concatenates the whole predict path into
+one ``mxnet_predict-all.cc`` so a model can be embedded with zero build
+dependencies (``amalgamation/README.md:1-14``). The equivalent property
+here — "one file you copy next to a checkpoint and run anywhere the
+runtime exists" — is a zipapp: this tool packs ``mxnet_tpu`` (pure
+Python; the native .so fast paths are optional accelerators, not
+dependencies) plus a predict ``__main__`` into ``mxtpu_predict.pyz``.
+
+    python tools/amalgamate.py -o mxtpu_predict.pyz
+    python mxtpu_predict.pyz --prefix model --epoch 3 \
+        --input data.npy [--output out.npy] [--topk 5]
+
+The bundle needs only the environment's python + jax/numpy (the same
+runtime contract the reference's amalgamated .cc had on BLAS).
+"""
+import argparse
+import os
+import sys
+import zipapp
+import zipfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_MAIN = '''\
+"""mxtpu_predict bundle entry: load a checkpoint, classify an input."""
+import argparse
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser(prog="mxtpu_predict.pyz")
+    ap.add_argument("--prefix", required=True,
+                    help="checkpoint prefix (prefix-symbol.json + "
+                         "prefix-NNNN.params)")
+    ap.add_argument("--epoch", type=int, required=True)
+    ap.add_argument("--input", required=True,
+                    help=".npy array for the 'data' input")
+    ap.add_argument("--data-name", default="data")
+    ap.add_argument("--output", default=None,
+                    help="write the full output array here (.npy)")
+    ap.add_argument("--topk", type=int, default=5)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    x = np.load(args.input)
+    pred = mx.predictor.Predictor.from_checkpoint(
+        args.prefix, args.epoch, {args.data_name: x.shape})
+    pred.forward(**{args.data_name: x})
+    out = pred.get_output(0)
+    out = out.asnumpy() if hasattr(out, "asnumpy") else np.asarray(out)
+    if args.output:
+        np.save(args.output, out)
+    flat = out.reshape(out.shape[0], -1)
+    for row in flat:
+        top = np.argsort(row)[::-1][:args.topk]
+        print(" ".join("%d:%.4f" % (i, row[i]) for i in top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
+'''
+
+
+def build(output, compress=True):
+    """Pack mxnet_tpu + the predict __main__ into a zipapp."""
+    import io
+    import py_compile  # noqa: F401  (documents the pure-python contract)
+
+    buf_dir = output + ".staging.zip"
+    pkg = os.path.join(ROOT, "mxnet_tpu")
+    comp = zipfile.ZIP_DEFLATED if compress else zipfile.ZIP_STORED
+    with zipfile.ZipFile(buf_dir, "w", comp) as z:
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if not fn.endswith(".py"):
+                    continue  # the .so fast paths are optional; the
+                    # bundle ships the pure-python package only
+                full = os.path.join(dirpath, fn)
+                z.write(full, os.path.relpath(full, ROOT))
+        z.writestr("__main__.py", _MAIN)
+    # zipapp prepends the shebang and validates __main__
+    zipapp.create_archive(buf_dir, output,
+                          interpreter="/usr/bin/env python3")
+    os.remove(buf_dir)
+    return output
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--output", default="mxtpu_predict.pyz")
+    cli = ap.parse_args()
+    out = build(cli.output)
+    print("wrote %s (%.1f KB)" % (out, os.path.getsize(out) / 1024.0))
+
+
+if __name__ == "__main__":
+    main()
