@@ -92,6 +92,7 @@ from .predictor import Predictor, load_exported
 from .ops import register_pallas_op, Param
 from . import rtc
 from . import torch as th
+from . import caffe
 from . import checkpoint
 from . import notebook
 from . import log

@@ -1,0 +1,32 @@
+"""CLI: Caffe deploy.prototxt + .caffemodel → mx checkpoint.
+
+Reference parity: tools/caffe_converter/run.sh convert_model.py —
+``python tools/caffe_converter.py deploy.prototxt net.caffemodel out``
+writes ``out-symbol.json`` + ``out-0000.params`` loadable with
+``mx.model.load_checkpoint("out", 0)``.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prototxt")
+    ap.add_argument("caffemodel")
+    ap.add_argument("prefix", help="output checkpoint prefix")
+    cli = ap.parse_args()
+
+    import mxnet_tpu as mx
+
+    sym, arg_params, aux_params = mx.caffe.convert(cli.prototxt,
+                                                   cli.caffemodel)
+    mx.model.save_checkpoint(cli.prefix, 0, sym, arg_params, aux_params)
+    print("wrote %s-symbol.json and %s-0000.params (%d arg, %d aux)"
+          % (cli.prefix, cli.prefix, len(arg_params), len(aux_params)))
+
+
+if __name__ == "__main__":
+    main()
