@@ -244,128 +244,15 @@ def convert_symbol(prototxt_text: str):
     for layer in layers:
         ltype = _norm_type(layer.get("type"))
         name = layer.get("name")
-        bots = _aslist(layer.get("bottom"))
         louts = _aslist(layer.get("top"))
         if ltype in ("Input", "Data", "HDF5Data", "ImageData"):
             v = sym_mod.Variable(louts[0] if louts else name)
             tops[louts[0] if louts else name] = v
             input_name = input_name or (louts[0] if louts else name)
             continue
-        if ltype in ("SoftmaxWithLoss", "Softmax"):
-            out = sym_mod.SoftmaxOutput(get(bots[0]), name=name)
-        elif ltype in ("Convolution", "Deconvolution"):
-            p = layer.get("convolution_param", {})
-            kh, kw = _kernel_pair(p, "kernel")
-            sh, sw = _kernel_pair(p, "stride", 1) if (
-                "stride" in p or "stride_h" in p) else (1, 1)
-            ph, pw = _kernel_pair(p, "pad", 0) if (
-                "pad" in p or "pad_h" in p) else (0, 0)
-            op = (sym_mod.Convolution if ltype == "Convolution"
-                  else sym_mod.Deconvolution)
-            out = op(get(bots[0]), name=name,
-                     num_filter=p["num_output"],
-                     kernel=(kh, kw), stride=(sh or 1, sw or 1),
-                     pad=(ph, pw),
-                     num_group=p.get("group", 1),
-                     no_bias=not p.get("bias_term", True))
-        elif ltype in ("InnerProduct",):
-            p = layer.get("inner_product_param", {})
-            out = sym_mod.FullyConnected(
-                sym_mod.Flatten(get(bots[0])), name=name,
-                num_hidden=p["num_output"],
-                no_bias=not p.get("bias_term", True))
-        elif ltype in ("Pooling",):
-            p = layer.get("pooling_param", {})
-            kh, kw = _kernel_pair(p, "kernel")
-            sh, sw = _kernel_pair(p, "stride", 1)
-            ph, pw = _kernel_pair(p, "pad", 0)
-            pool = {0: "max", 1: "avg", "MAX": "max",
-                    "AVE": "avg"}.get(p.get("pool", 0), "max")
-            if p.get("global_pooling"):
-                out = sym_mod.Pooling(get(bots[0]), name=name,
-                                      kernel=(1, 1), global_pool=True,
-                                      pool_type=pool)
-            else:
-                # Caffe pools with ceil-mode window placement
-                out = sym_mod.Pooling(
-                    get(bots[0]), name=name, kernel=(kh, kw),
-                    stride=(sh or 1, sw or 1), pad=(ph, pw),
-                    pool_type=pool,
-                    pooling_convention="full")
-        elif ltype in ("ReLU",):
-            out = sym_mod.Activation(get(bots[0]), name=name,
-                                     act_type="relu")
-        elif ltype == "PReLU":
-            out = sym_mod.LeakyReLU(get(bots[0]), name=name,
-                                    act_type="prelu")
-        elif ltype in ("Sigmoid",):
-            out = sym_mod.Activation(get(bots[0]), name=name,
-                                     act_type="sigmoid")
-        elif ltype in ("TanH",):
-            out = sym_mod.Activation(get(bots[0]), name=name,
-                                     act_type="tanh")
-        elif ltype in ("Dropout",):
-            p = layer.get("dropout_param", {})
-            out = sym_mod.Dropout(get(bots[0]), name=name,
-                                  p=p.get("dropout_ratio", 0.5))
-        elif ltype in ("LRN",):
-            p = layer.get("lrn_param", {})
-            out = sym_mod.LRN(get(bots[0]), name=name,
-                              alpha=p.get("alpha", 1e-4),
-                              beta=p.get("beta", 0.75),
-                              knorm=p.get("k", 1.0),
-                              nsize=p.get("local_size", 5))
-        elif ltype == "BatchNorm":
-            p = layer.get("batch_norm_param", {})
-            # fix_gamma=False: a following Scale layer's gamma/beta fold
-            # into this op's arg params (without Scale the defaults
-            # gamma=1/beta=0 reproduce bare caffe BatchNorm)
-            out = sym_mod.BatchNorm(get(bots[0]), name=name,
-                                    eps=p.get("eps", 1e-5),
-                                    use_global_stats=True,
-                                    fix_gamma=False)
-        elif ltype == "Scale":
-            # Caffe pairs BatchNorm (normalize) + Scale (gamma/beta);
-            # mx BatchNorm holds all four — the Scale layer merges into
-            # its bottom BatchNorm (reference convert_symbol.py does the
-            # same): symbol-side it is identity, param-side
-            # convert_model folds the blobs in. A standalone Scale has
-            # no BatchNorm to fold into — refuse rather than silently
-            # dropping the scaling math.
-            if _bn_producer(layers, bots[0]) is None:
-                raise NotImplementedError(
-                    "standalone Scale layer %r (bottom %r is not a "
-                    "BatchNorm output) is not supported" % (name, bots[0]))
-            out = get(bots[0])
-        elif ltype in ("Concat",):
-            p = layer.get("concat_param", {})
-            out = sym_mod.Concat(*[get(b) for b in bots], name=name,
-                                 dim=p.get("axis", 1))
-        elif ltype == "Eltwise":
-            p = layer.get("eltwise_param", {})
-            op = p.get("operation", "SUM")
-            ins = [get(b) for b in bots]  # caffe allows N bottoms
-            out = ins[0]
-            for rhs in ins[1:]:
-                if op in ("SUM", 1):
-                    out = out + rhs
-                elif op in ("PROD", 0):
-                    out = out * rhs
-                else:
-                    out = sym_mod.maximum(out, rhs)
-        elif ltype in ("Flatten",):
-            out = sym_mod.Flatten(get(bots[0]), name=name)
-        elif ltype == "Reshape":
-            p = layer.get("reshape_param", {})
-            dims = tuple(_aslist(p.get("shape", {}).get("dim", [])))
-            out = sym_mod.Reshape(get(bots[0]), name=name, shape=dims)
-        elif ltype in ("Split",):
-            out = get(bots[0])
-        elif ltype in ("Accuracy", "SoftmaxWithLossWeight"):
+        out = _emit_layer(sym_mod, layer, get, layers)
+        if out is _SKIP:
             continue
-        else:
-            raise NotImplementedError(
-                "caffe layer type %r (%s) not supported" % (ltype, name))
         for t in (louts or [name]):
             tops[t] = out
         last = out
@@ -373,6 +260,134 @@ def convert_symbol(prototxt_text: str):
     if last is None:
         raise ValueError("prototxt contains no convertible layers")
     return last, input_name
+
+
+_SKIP = object()
+
+
+def _emit_layer(sym_mod, layer, get, layers):
+    """One caffe layer → one mx symbol expression (the ONE mapping both
+    convert_symbol and CaffeOp use). ``get(bottom)`` resolves inputs;
+    returns _SKIP for non-compute layers."""
+    ltype = _norm_type(layer.get("type"))
+    name = layer.get("name")
+    bots = _aslist(layer.get("bottom"))
+    if ltype in ("SoftmaxWithLoss", "Softmax"):
+        out = sym_mod.SoftmaxOutput(get(bots[0]), name=name)
+    elif ltype in ("Convolution", "Deconvolution"):
+        p = layer.get("convolution_param", {})
+        kh, kw = _kernel_pair(p, "kernel")
+        sh, sw = _kernel_pair(p, "stride", 1) if (
+            "stride" in p or "stride_h" in p) else (1, 1)
+        ph, pw = _kernel_pair(p, "pad", 0) if (
+            "pad" in p or "pad_h" in p) else (0, 0)
+        op = (sym_mod.Convolution if ltype == "Convolution"
+              else sym_mod.Deconvolution)
+        out = op(get(bots[0]), name=name,
+                 num_filter=p["num_output"],
+                 kernel=(kh, kw), stride=(sh or 1, sw or 1),
+                 pad=(ph, pw),
+                 num_group=p.get("group", 1),
+                 no_bias=not p.get("bias_term", True))
+    elif ltype in ("InnerProduct",):
+        p = layer.get("inner_product_param", {})
+        out = sym_mod.FullyConnected(
+            sym_mod.Flatten(get(bots[0])), name=name,
+            num_hidden=p["num_output"],
+            no_bias=not p.get("bias_term", True))
+    elif ltype in ("Pooling",):
+        p = layer.get("pooling_param", {})
+        kh, kw = _kernel_pair(p, "kernel")
+        sh, sw = _kernel_pair(p, "stride", 1)
+        ph, pw = _kernel_pair(p, "pad", 0)
+        pool = {0: "max", 1: "avg", "MAX": "max",
+                "AVE": "avg"}.get(p.get("pool", 0), "max")
+        if p.get("global_pooling"):
+            out = sym_mod.Pooling(get(bots[0]), name=name,
+                                  kernel=(1, 1), global_pool=True,
+                                  pool_type=pool)
+        else:
+            # Caffe pools with ceil-mode window placement
+            out = sym_mod.Pooling(
+                get(bots[0]), name=name, kernel=(kh, kw),
+                stride=(sh or 1, sw or 1), pad=(ph, pw),
+                pool_type=pool,
+                pooling_convention="full")
+    elif ltype in ("ReLU",):
+        out = sym_mod.Activation(get(bots[0]), name=name,
+                                 act_type="relu")
+    elif ltype == "PReLU":
+        out = sym_mod.LeakyReLU(get(bots[0]), name=name,
+                                act_type="prelu")
+    elif ltype in ("Sigmoid",):
+        out = sym_mod.Activation(get(bots[0]), name=name,
+                                 act_type="sigmoid")
+    elif ltype in ("TanH",):
+        out = sym_mod.Activation(get(bots[0]), name=name,
+                                 act_type="tanh")
+    elif ltype in ("Dropout",):
+        p = layer.get("dropout_param", {})
+        out = sym_mod.Dropout(get(bots[0]), name=name,
+                              p=p.get("dropout_ratio", 0.5))
+    elif ltype in ("LRN",):
+        p = layer.get("lrn_param", {})
+        out = sym_mod.LRN(get(bots[0]), name=name,
+                          alpha=p.get("alpha", 1e-4),
+                          beta=p.get("beta", 0.75),
+                          knorm=p.get("k", 1.0),
+                          nsize=p.get("local_size", 5))
+    elif ltype == "BatchNorm":
+        p = layer.get("batch_norm_param", {})
+        # fix_gamma=False: a following Scale layer's gamma/beta fold
+        # into this op's arg params (without Scale the defaults
+        # gamma=1/beta=0 reproduce bare caffe BatchNorm)
+        out = sym_mod.BatchNorm(get(bots[0]), name=name,
+                                eps=p.get("eps", 1e-5),
+                                use_global_stats=True,
+                                fix_gamma=False)
+    elif ltype == "Scale":
+        # Caffe pairs BatchNorm (normalize) + Scale (gamma/beta);
+        # mx BatchNorm holds all four — the Scale layer merges into
+        # its bottom BatchNorm (reference convert_symbol.py does the
+        # same): symbol-side it is identity, param-side
+        # convert_model folds the blobs in. A standalone Scale has
+        # no BatchNorm to fold into — refuse rather than silently
+        # dropping the scaling math.
+        if _bn_producer(layers, bots[0]) is None:
+            raise NotImplementedError(
+                "standalone Scale layer %r (bottom %r is not a "
+                "BatchNorm output) is not supported" % (name, bots[0]))
+        out = get(bots[0])
+    elif ltype in ("Concat",):
+        p = layer.get("concat_param", {})
+        out = sym_mod.Concat(*[get(b) for b in bots], name=name,
+                             dim=p.get("axis", 1))
+    elif ltype == "Eltwise":
+        p = layer.get("eltwise_param", {})
+        op = p.get("operation", "SUM")
+        ins = [get(b) for b in bots]  # caffe allows N bottoms
+        out = ins[0]
+        for rhs in ins[1:]:
+            if op in ("SUM", 1):
+                out = out + rhs
+            elif op in ("PROD", 0):
+                out = out * rhs
+            else:
+                out = sym_mod.maximum(out, rhs)
+    elif ltype in ("Flatten",):
+        out = sym_mod.Flatten(get(bots[0]), name=name)
+    elif ltype == "Reshape":
+        p = layer.get("reshape_param", {})
+        dims = tuple(_aslist(p.get("shape", {}).get("dim", [])))
+        out = sym_mod.Reshape(get(bots[0]), name=name, shape=dims)
+    elif ltype in ("Split",):
+        out = get(bots[0])
+    elif ltype in ("Accuracy", "SoftmaxWithLossWeight"):
+        return _SKIP
+    else:
+        raise NotImplementedError(
+            "caffe layer type %r (%s) not supported" % (ltype, name))
+    return out
 
 
 def convert_model(prototxt_text: str, caffemodel_bytes: bytes):
@@ -440,6 +455,59 @@ def _bn_producer(layers, top):
                 _norm_type(la.get("type")) == "BatchNorm":
             return la.get("name")
     return None
+
+
+def convert_mean(binaryproto: bytes) -> np.ndarray:
+    """Mean-file BlobProto → (C, H, W) array (reference
+    convert_mean.py). Accepts the raw bytes of a .binaryproto file.
+    Real mean files carry legacy num/channels/height/width dims with
+    num=1 — squeezed to match the reference tool's output shape."""
+    arr = _parse_blob(memoryview(binaryproto))
+    if arr.ndim == 4 and arr.shape[0] == 1:
+        arr = arr[0]
+    return arr
+
+
+_CAFFEOP_SEQ = 0
+
+
+def CaffeOp(data, prototxt: str, name=None):
+    """Single-layer runtime sugar — the reference plugin's CaffeOp
+    (``plugin/caffe/caffe_operator.cc``) embedded a Caffe layer spec in
+    the graph and ran Caffe's kernel; here the same prototxt snippet is
+    mapped onto the native op registry at graph-build time:
+
+        net = mx.caffe.CaffeOp(net, 'layer { name: "c1" '
+                               'type: "Convolution" convolution_param '
+                               '{ num_output: 8 kernel_size: 3 } }')
+
+    The snippet must contain exactly one layer; bottom/top wiring is
+    implied by ``data``."""
+    cfg = parse_prototxt(prototxt)
+    layers = _aslist(cfg.get("layer")) or _aslist(cfg.get("layers"))
+    if not layers and cfg.get("type"):
+        layers = [cfg]  # bare `name: ... type: ...` body
+    if len(layers) != 1:
+        raise ValueError("CaffeOp needs exactly one layer in the "
+                         "prototxt snippet (got %d)" % len(layers))
+    layer = dict(layers[0])
+    if name is not None:
+        layer["name"] = name
+    if "name" not in layer:
+        # unique per call — two unnamed parametric layers must not
+        # silently share '<name>_weight' params
+        global _CAFFEOP_SEQ
+        _CAFFEOP_SEQ += 1
+        layer["name"] = "caffeop%d" % _CAFFEOP_SEQ
+    layer["bottom"] = "_caffeop_in"
+    layer["top"] = layer["name"]
+    from . import symbol as sym_mod
+
+    out = _emit_layer(sym_mod, layer, lambda bottom: data, [layer])
+    if out is _SKIP:
+        raise ValueError("layer type %r emits no computation"
+                         % layer.get("type"))
+    return out
 
 
 def convert(prototxt_path: str, caffemodel_path: str):

@@ -184,6 +184,57 @@ layer { name: "e" type: "Eltwise" bottom: "data" bottom: "data"
         caffe.convert_symbol(bad)
 
 
+def test_caffeop_single_layer_sugar():
+    """Runtime parity with plugin/caffe CaffeOp: embed one prototxt
+    layer spec in a native graph."""
+    net = mx.sym.Variable("data")
+    net = mx.caffe.CaffeOp(net, 'layer { name: "c1" type: "Convolution" '
+                                'convolution_param { num_output: 2 '
+                                'kernel_size: 3 pad: 1 } }')
+    net = mx.caffe.CaffeOp(net, 'layer { type: "ReLU" }', name="r1")
+    ex = net.simple_bind(mx.cpu(), data=(1, 3, 5, 5), grad_req="null")
+    rng = np.random.RandomState(0)
+    for k in ex.arg_dict:
+        if k != "data":
+            ex.arg_dict[k][:] = rng.randn(
+                *ex.arg_dict[k].shape).astype("f") * 0.1
+    out = ex.forward(is_train=False,
+                     data=rng.randn(1, 3, 5, 5).astype("f"))[0].asnumpy()
+    assert out.shape == (1, 2, 5, 5)
+    assert (out >= 0).all()  # the ReLU layer applied
+
+
+def test_convert_mean():
+    # shape-field encoding: num=1 leading dim squeezed to (C, H, W)
+    arr = np.arange(12, dtype="f").reshape(1, 3, 2, 2)
+    back = caffe.convert_mean(caffe.encode_blob(arr))
+    np.testing.assert_array_equal(back, arr[0])
+    # legacy num/channels/height/width dims (what real mean files use)
+    data = arr.ravel().tobytes()
+    legacy = (caffe._enc_field(1, 0, caffe._enc_varint(1))
+              + caffe._enc_field(2, 0, caffe._enc_varint(3))
+              + caffe._enc_field(3, 0, caffe._enc_varint(2))
+              + caffe._enc_field(4, 0, caffe._enc_varint(2))
+              + caffe._enc_field(5, 2,
+                                 caffe._enc_varint(len(data)) + data))
+    back = caffe.convert_mean(legacy)
+    assert back.shape == (3, 2, 2)
+    np.testing.assert_array_equal(back, arr[0])
+
+
+def test_caffeop_unnamed_layers_get_unique_params():
+    net = mx.sym.Variable("data")
+    net = mx.caffe.CaffeOp(net, 'layer { type: "Convolution" '
+                                'convolution_param { num_output: 2 '
+                                'kernel_size: 1 } }')
+    net = mx.caffe.CaffeOp(net, 'layer { type: "Convolution" '
+                                'convolution_param { num_output: 2 '
+                                'kernel_size: 1 } }')
+    args = net.list_arguments()
+    weights = [a for a in args if a.endswith("_weight")]
+    assert len(weights) == 2 and weights[0] != weights[1], args
+
+
 def test_v1_layers_field_and_legacy_blob_dims():
     """V1 NetParameter uses field 2 (layers), name=4, blobs=6, and
     legacy num/channels/height/width blob dims."""
