@@ -36,6 +36,13 @@ def main():
     # every target prints a verdict; a missing row is an explicit
     # MISSING (a wedged run must not look like "nothing was in scope")
     print("\n--- targets (BASELINE.md two-track) ---")
+    ms = got("flash_train_model_shape").get("result") or {}
+    if ms.get("mfu") is not None:
+        print("flash kernel at MODEL shapes (b=4 h=16 s=4k): "
+              "%.1f TFLOP/s / %.1f%% MFU" % (ms["value"],
+                                             100 * ms["mfu"]))
+    else:
+        print("flash kernel at MODEL shapes: MISSING")
     best = got("flash_train_best")
     mfu = best.get("mfu")
     print("flash kernel MFU: %s (target >=0.40; r4 best 0.243): %s"
@@ -47,8 +54,34 @@ def main():
           % (v, "MISSING" if v is None
              else ("PASS" if v >= 2400 else "below")))
     lm = bench.get("transformer_lm_mfu")
-    print("transformer_lm_mfu: %s (target >=0.30; attn=%s): %s"
-          % (lm, bench.get("transformer_lm_attn"),
+    src = "checklist"
+    if lm is None:
+        # fall back to the standalone model-level artifact (builder-run
+        # measurements survive a wedged checklist window). The side
+        # file's round is derived from the checklist path so a future
+        # round's wedged run cannot pass on a stale artifact.
+        import os
+        import re
+
+        m = re.search(r"(r\d+)", os.path.basename(path))
+        side = os.path.join(os.path.dirname(path) or ".",
+                            "lm_model_%s.jsonl" % (m.group(1) if m
+                                                   else "r05"))
+        if os.path.exists(side):
+            recs = []
+            with open(side) as f:
+                for x in f:  # same tolerant parse as the main loader
+                    x = x.strip()
+                    if x.startswith("{"):
+                        try:
+                            recs.append(json.loads(x))
+                        except ValueError:
+                            pass
+            flash = [r for r in recs if r.get("attn") == "flash"]
+            if flash:
+                lm, src = flash[-1].get("mfu"), os.path.basename(side)
+    print("transformer_lm_mfu: %s (target >=0.30; attn=%s; src=%s): %s"
+          % (lm, bench.get("transformer_lm_attn") or "flash", src,
              "MISSING" if lm is None
              else ("PASS" if lm >= 0.30 else "below")))
     orc = got("splash_oracle").get("result") or {}

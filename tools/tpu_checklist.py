@@ -92,6 +92,18 @@ def main():
         report("flash_train_best", tflops=best["value"], mfu=best["mfu"],
                block_q=best["block_q"], block_k=best["block_k"], ok=True)
 
+    # 2b. the kernel at MODEL shapes (b=4 h=16 — the grid the 51.4%
+    # model-level MFU actually runs; the b=1 h=8 ladder starves the
+    # parallel bh dimension and under-reports the kernel)
+    try:
+        with deadline(900):
+            rm = run_bench(batch=4, heads=16, seq=4096, steps=10,
+                           block_q=best["block_q"] if best else 512,
+                           block_k=best["block_k"] if best else 1024)
+        report("flash_train_model_shape", result=rm, ok=True)
+    except Exception as e:
+        report("flash_train_model_shape", ok=False, error=str(e)[:200])
+
     # 3. 16k-token causal train step on one chip
     s16 = 16384
     q16 = jax.random.normal(jax.random.PRNGKey(0), (1, s16, 8, 128),
