@@ -34,6 +34,11 @@ typedef uint32_t mx_uint;
 
 const char* MXTPUGetLastError(void);
 
+/* Library version string (mx.__version__); thread-local storage. */
+int MXTPUGetVersion(const char** out);
+/* Seed the global RNG resource (reference MXRandomSeed). */
+int MXTPURandomSeed(int seed);
+
 /* Create a zero-filled array. dev_type: 1=cpu, 2=gpu/accelerator. */
 int MXTPUNDArrayCreate(const mx_uint* shape, mx_uint ndim, int dev_type,
                        int dev_id, int dtype_flag, NDArrayHandle* out);
@@ -46,6 +51,17 @@ int MXTPUNDArrayGetShape(NDArrayHandle handle, mx_uint* out_ndim,
                          const mx_uint** out_data);
 
 int MXTPUNDArrayGetDType(NDArrayHandle handle, int* out_dtype);
+
+/* Views/copies (reference MXNDArraySlice / MXNDArrayReshape /
+ * MXNDArrayGetContext and imperative CopyFromTo). Slice/Reshape return
+ * NEW handles the caller frees. */
+int MXTPUNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                      NDArrayHandle* out);
+int MXTPUNDArrayReshape(NDArrayHandle handle, int ndim, const int* dims,
+                        NDArrayHandle* out);
+int MXTPUNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                           int* out_dev_id);
+int MXTPUNDArrayCopyFromTo(NDArrayHandle src, NDArrayHandle dst);
 
 /* Synchronous host<->device copies; nbytes must equal the array's byte
  * size in its own dtype. */
@@ -151,6 +167,16 @@ typedef void* ExecutorHandle;
 
 int MXTPUSymbolCreateFromJSON(const char* json, SymbolHandle* out);
 int MXTPUSymbolCreateFromFile(const char* fname, SymbolHandle* out);
+/* C-side graph building (reference c_api_symbolic.cc
+ * MXSymbolCreateVariable / MXSymbolCreateAtomicSymbol / MXSymbolCompose):
+ * create an uncomposed op with string attrs, then wire its inputs in
+ * place.  keys==NULL composes positionally. */
+int MXTPUSymbolCreateVariable(const char* name, SymbolHandle* out);
+int MXTPUSymbolCreateAtomicSymbol(const char* op_name, mx_uint num_param,
+                                  const char** keys, const char** vals,
+                                  SymbolHandle* out);
+int MXTPUSymbolCompose(SymbolHandle sym, const char* name, mx_uint num_args,
+                       const char** keys, SymbolHandle* args);
 /* *out_json is thread-local storage, valid until the next call. */
 int MXTPUSymbolSaveToJSON(SymbolHandle sym, const char** out_json);
 /* Name tables are thread-local storage, valid until the next call. */
@@ -210,6 +236,11 @@ int MXTPUExecutorBackward(ExecutorHandle handle, mx_uint num_heads,
  * on the array, MXTPUNDArrayFree on each handle). */
 int MXTPUExecutorOutputs(ExecutorHandle handle, mx_uint* out_size,
                          NDArrayHandle** out);
+/* New static shapes -> a NEW executor handle (reference
+ * MXExecutorReshape); the old handle stays valid. */
+int MXTPUExecutorReshape(ExecutorHandle handle, mx_uint num_args,
+                         const char** keys, const mx_uint* arg_ndims,
+                         const mx_uint** arg_shapes, ExecutorHandle* out);
 int MXTPUExecutorFree(ExecutorHandle handle);
 
 #ifdef __cplusplus

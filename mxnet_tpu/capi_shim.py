@@ -38,6 +38,12 @@ class _HandleRegistry:
         with self._lock:
             self._items.pop(hid, None)
 
+    def replace(self, hid, obj):
+        with self._lock:
+            if hid not in self._items:
+                raise KeyError("invalid handle %d" % hid)
+            self._items[hid] = obj
+
 
 _predictors = _HandleRegistry()
 
@@ -239,7 +245,12 @@ def sym_from_file(fname):
 
 
 def _sym_get(hid):
-    return _symbols.get(hid, "Symbol")
+    obj = _symbols.get(hid, "Symbol")
+    if isinstance(obj, _PendingAtomic):
+        raise ValueError(
+            "symbol handle %d is an uncomposed atomic symbol (%s); call "
+            "MXTPUSymbolCompose to wire its inputs first" % (hid, obj.op))
+    return obj
 
 
 def sym_tojson(hid):
@@ -486,3 +497,101 @@ def kv_group_size(hid):
 
 def kv_barrier(hid):
     _kv_get(hid)._barrier()
+
+
+# ---------------------------------------------------------------------------
+# Round-5 breadth: C-side graph building (reference c_api_symbolic.cc
+# MXSymbolCreateVariable/CreateAtomicSymbol/Compose), NDArray views
+# (c_api.cc MXNDArraySlice/Reshape/GetContext, CopyFromTo), executor
+# reshape, version/seed.
+# ---------------------------------------------------------------------------
+
+
+def sym_variable(name):
+    from . import symbol
+
+    return _symbols.put(symbol.Variable(name))
+
+
+class _PendingAtomic:
+    """CreateAtomicSymbol's result before Compose wires its inputs —
+    mirrors the reference's uncomposed nnvm node."""
+
+    def __init__(self, op, attrs):
+        self.op = op
+        self.attrs = attrs
+
+
+def sym_atomic(op_name, keys, vals):
+    return _symbols.put(_PendingAtomic(op_name, dict(zip(keys, vals))))
+
+
+def sym_compose(hid, name, keys, arg_hids):
+    """Wire inputs into a symbol IN PLACE (the reference composes the
+    same handle). Atomic handles become real symbols by calling the op;
+    already-real symbols (e.g. loaded from JSON) have their free
+    variables substituted via Symbol.compose. keys empty -> positional
+    (atomic: the op's input order; real: list_arguments order)."""
+    from . import symbol
+
+    target = _symbols.get(hid, "Symbol")
+    args = [_sym_get(h) for h in arg_hids]
+    if isinstance(target, _PendingAtomic):
+        op = getattr(symbol, target.op, None)
+        if op is None:
+            raise ValueError("unknown operator %r" % target.op)
+        attrs = dict(target.attrs)
+        if name:
+            attrs.setdefault("name", name)
+        if keys:
+            composed = op(**dict(zip(keys, args)), **attrs)
+        else:
+            composed = op(*args, **attrs)
+    else:
+        if not keys:
+            keys = list(target.list_arguments())[:len(args)]
+        composed = target.compose(**dict(zip(keys, args)))
+    _symbols.replace(hid, composed)
+
+
+def nd_slice(hid, begin, end):
+    arr = _nd_get(hid)
+    begin, end = int(begin), int(end)
+    if not 0 <= begin <= end <= arr.shape[0]:
+        # the reference MXNDArraySlice CHECKs the range; numpy's silent
+        # clamping would hand a C caller a wrong-sized array
+        raise ValueError("invalid slice [%d, %d) for axis-0 extent %d"
+                         % (begin, end, arr.shape[0]))
+    return _nd_put(arr[begin:end])
+
+
+def nd_reshape(hid, dims):
+    return _nd_put(_nd_get(hid).reshape(tuple(int(d) for d in dims)))
+
+
+def nd_context(hid):
+    ctx = _nd_get(hid).context
+    return int(ctx.device_typeid), int(ctx.device_id)
+
+
+def nd_copyfromto(src_hid, dst_hid):
+    _nd_get(src_hid).copyto(_nd_get(dst_hid))
+
+
+def exec_reshape(hid, keys, shapes):
+    ex = _executors.get(hid, "Executor")
+    new = ex.reshape(**{k: tuple(int(d) for d in s)
+                        for k, s in zip(keys, shapes)})
+    return _executors.put(new)
+
+
+def random_seed(seed):
+    from . import random as rnd
+
+    rnd.seed(int(seed))
+
+
+def version():
+    from . import __version__
+
+    return str(__version__)

@@ -115,6 +115,41 @@ class Symbol:
             out.extend(n.aux_names())
         return out
 
+    def compose(self, **kwargs) -> "Symbol":
+        """Substitute free variable inputs by name with other symbols'
+        outputs, rebuilding the node DAG — the graph-level half of the
+        reference's MXSymbolCompose (c_api_symbolic.cc:200-260; nnvm
+        composes atomic symbols the same way). Unknown names raise."""
+        args = set(self.list_arguments())
+        unknown = set(kwargs) - args
+        if unknown:
+            raise ValueError(
+                "compose: %s are not free arguments of this symbol "
+                "(free: %s)" % (sorted(unknown), sorted(args)))
+        sub = {k: v._outputs[0] for k, v in kwargs.items()}
+        memo: Dict[int, _Node] = {}
+
+        def sub_input(inp):
+            node, idx = inp
+            if node.is_variable and node.name in sub:
+                return sub[node.name]
+            return (rebuild(node), idx)
+
+        def rebuild(node):
+            got = memo.get(id(node))
+            if got is not None:
+                return got
+            if node.is_variable:
+                memo[id(node)] = node
+                return node
+            new = _Node(node.op, node.name, node.attrs,
+                        [sub_input(i) for i in node.inputs],
+                        node.attr_dict)
+            memo[id(node)] = new
+            return new
+
+        return Symbol([sub_input(o) for o in self._outputs])
+
     def get_internals(self) -> "Symbol":
         entries = []
         for n in self._nodes():

@@ -759,4 +759,155 @@ int MXTPUExecutorFree(void* handle) {
   return 0;
 }
 
+/* ------------------------------------------------------------------ */
+/* Round-5 breadth: C-side graph building, NDArray views, executor     */
+/* reshape, version/seed (reference c_api_symbolic.cc:54-220,          */
+/* c_api.cc MXNDArraySlice/Reshape/GetContext, MXExecutorReshape,      */
+/* MXGetVersion, MXRandomSeed).                                        */
+
+int MXTPUSymbolCreateVariable(const char* name, void** out) {
+  ensure_python();
+  GIL gil;
+  PyObject* res = call_shim("sym_variable", "(s)", name);
+  if (!res) return -1;
+  *out = new SymHandle{PyLong_AsLongLong(res)};
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUSymbolCreateAtomicSymbol(const char* op_name, mx_uint num_param,
+                                  const char** keys, const char** vals,
+                                  void** out) {
+  ensure_python();
+  GIL gil;
+  PyObject* pkeys = PyList_New(num_param);
+  PyObject* pvals = PyList_New(num_param);
+  for (mx_uint i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(pvals, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* res = call_shim("sym_atomic", "(sOO)", op_name, pkeys, pvals);
+  Py_DECREF(pkeys);
+  Py_DECREF(pvals);
+  if (!res) return -1;
+  *out = new SymHandle{PyLong_AsLongLong(res)};
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUSymbolCompose(void* sym, const char* name, mx_uint num_args,
+                       const char** keys, void** args) {
+  GIL gil;
+  PyObject* pkeys = PyList_New(keys ? num_args : 0);
+  PyObject* phids = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    if (keys) PyList_SET_ITEM(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(phids, i, PyLong_FromLongLong(
+        static_cast<SymHandle*>(args[i])->hid));
+  }
+  PyObject* res = call_shim("sym_compose", "(LsOO)",
+                            static_cast<SymHandle*>(sym)->hid,
+                            name ? name : "", pkeys, phids);
+  Py_DECREF(pkeys);
+  Py_DECREF(phids);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArraySlice(void* handle, mx_uint begin, mx_uint end, void** out) {
+  GIL gil;
+  PyObject* res = call_shim("nd_slice", "(LII)",
+                            static_cast<NDHandle*>(handle)->hid,
+                            begin, end);
+  if (!res) return -1;
+  *out = new NDHandle{PyLong_AsLongLong(res)};
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayReshape(void* handle, int ndim, const int* dims, void** out) {
+  GIL gil;
+  PyObject* pdims = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyList_SET_ITEM(pdims, i, PyLong_FromLong(dims[i]));
+  }
+  PyObject* res = call_shim("nd_reshape", "(LO)",
+                            static_cast<NDHandle*>(handle)->hid, pdims);
+  Py_DECREF(pdims);
+  if (!res) return -1;
+  *out = new NDHandle{PyLong_AsLongLong(res)};
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayGetContext(void* handle, int* out_dev_type,
+                           int* out_dev_id) {
+  GIL gil;
+  PyObject* res = call_shim("nd_context", "(L)",
+                            static_cast<NDHandle*>(handle)->hid);
+  if (!res) return -1;
+  *out_dev_type = static_cast<int>(
+      PyLong_AsLong(PyTuple_GET_ITEM(res, 0)));
+  *out_dev_id = static_cast<int>(
+      PyLong_AsLong(PyTuple_GET_ITEM(res, 1)));
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUNDArrayCopyFromTo(void* src, void* dst) {
+  GIL gil;
+  PyObject* res = call_shim("nd_copyfromto", "(LL)",
+                            static_cast<NDHandle*>(src)->hid,
+                            static_cast<NDHandle*>(dst)->hid);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUExecutorReshape(void* handle, mx_uint num_args, const char** keys,
+                         const mx_uint* arg_ndims,
+                         const mx_uint** arg_shapes, void** out) {
+  GIL gil;
+  PyObject* pkeys = PyList_New(num_args);
+  PyObject* pshapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyObject* shp = PyTuple_New(arg_ndims[i]);
+    for (mx_uint j = 0; j < arg_ndims[i]; ++j) {
+      PyTuple_SET_ITEM(shp, j, PyLong_FromUnsignedLong(arg_shapes[i][j]));
+    }
+    PyList_SET_ITEM(pshapes, i, shp);
+  }
+  PyObject* res = call_shim("exec_reshape", "(LOO)",
+                            static_cast<ExecHandle*>(handle)->hid,
+                            pkeys, pshapes);
+  Py_DECREF(pkeys);
+  Py_DECREF(pshapes);
+  if (!res) return -1;
+  *out = new ExecHandle{PyLong_AsLongLong(res)};
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXTPUGetVersion(const char** out) {
+  ensure_python();
+  GIL gil;
+  PyObject* res = call_shim("version", "()");
+  if (!res) return -1;
+  t_json = PyUnicode_AsUTF8(res);
+  Py_DECREF(res);
+  *out = t_json.c_str();
+  return 0;
+}
+
+int MXTPURandomSeed(int seed) {
+  ensure_python();
+  GIL gil;
+  PyObject* res = call_shim("random_seed", "(i)", seed);
+  if (!res) return -1;
+  Py_DECREF(res);
+  return 0;
+}
+
 }  // extern "C"

@@ -532,3 +532,157 @@ def test_c_kvstore_surface():
     for h in (wh, gh, outh):
         lib.MXTPUNDArrayFree(h)
     assert lib.MXTPUKVStoreFree(kv) == 0
+
+
+def test_c_graph_building_and_views():
+    """Round-5 breadth: build a graph from C with CreateVariable/
+    CreateAtomicSymbol/Compose (no JSON), bind, forward; NDArray
+    slice/reshape/context/copy; executor reshape; version/seed
+    (reference c_api_symbolic.cc:54-220, MXExecutorReshape)."""
+    lib = _build_lib()
+    err = lambda: lib.MXTPUGetLastError().decode()
+
+    # version
+    out = ctypes.c_char_p()
+    assert lib.MXTPUGetVersion(ctypes.byref(out)) == 0, err()
+    assert out.value.decode() == mx.__version__
+
+    assert lib.MXTPURandomSeed(7) == 0, err()
+
+    # data variable + FullyConnected(num_hidden=3) composed from C
+    data = ctypes.c_void_p()
+    assert lib.MXTPUSymbolCreateVariable(
+        b"data", ctypes.byref(data)) == 0, err()
+    fc = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 2)(b"num_hidden", b"no_bias")
+    vals = (ctypes.c_char_p * 2)(b"3", b"True")
+    assert lib.MXTPUSymbolCreateAtomicSymbol(
+        b"FullyConnected", 2, keys, vals, ctypes.byref(fc)) == 0, err()
+    ckeys = (ctypes.c_char_p * 1)(b"data")
+    args = (ctypes.c_void_p * 1)(data)
+    assert lib.MXTPUSymbolCompose(fc, b"fc0", 1, ckeys, args) == 0, err()
+
+    # the composed symbol lists the generated weight argument
+    n = ctypes.c_uint32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXTPUSymbolListArguments(
+        fc, ctypes.byref(n), ctypes.byref(names)) == 0, err()
+    arg_names = [names[i].decode() for i in range(n.value)]
+    assert arg_names == ["data", "fc0_weight"], arg_names
+
+    # bind with C-created NDArrays and forward
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 6).astype(np.float32)
+    wv = rng.randn(3, 6).astype(np.float32)
+
+    def c_array(v):
+        h = ctypes.c_void_p()
+        shp = (ctypes.c_uint32 * v.ndim)(*v.shape)
+        assert lib.MXTPUNDArrayCreate(shp, v.ndim, 1, 0, 0,
+                                      ctypes.byref(h)) == 0, err()
+        assert lib.MXTPUNDArraySyncCopyFromCPU(
+            h, v.ctypes.data_as(ctypes.c_void_p), v.nbytes) == 0, err()
+        return h
+
+    hx, hw = c_array(xv), c_array(wv)
+    arg_handles = (ctypes.c_void_p * 2)(hx, hw)
+    ex = ctypes.c_void_p()
+    assert lib.MXTPUExecutorBind(fc, 1, 0, 2, arg_handles, None, None,
+                                 0, None, ctypes.byref(ex)) == 0, err()
+    assert lib.MXTPUExecutorForward(ex, 0) == 0, err()
+    n_out = ctypes.c_uint32()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXTPUExecutorOutputs(
+        ex, ctypes.byref(n_out), ctypes.byref(outs)) == 0, err()
+    got = np.zeros((4, 3), np.float32)
+    assert lib.MXTPUNDArraySyncCopyToCPU(
+        ctypes.c_void_p(outs[0]),
+        got.ctypes.data_as(ctypes.c_void_p), got.nbytes) == 0
+    np.testing.assert_allclose(got, xv @ wv.T, rtol=1e-4, atol=1e-5)
+
+    # views: slice rows 1:3, reshape to (3, 4), context
+    hs = ctypes.c_void_p()
+    assert lib.MXTPUNDArraySlice(hx, 1, 3, ctypes.byref(hs)) == 0, err()
+    sl = np.zeros((2, 6), np.float32)
+    assert lib.MXTPUNDArraySyncCopyToCPU(
+        hs, sl.ctypes.data_as(ctypes.c_void_p), sl.nbytes) == 0
+    np.testing.assert_array_equal(sl, xv[1:3])
+    hr = ctypes.c_void_p()
+    dims = (ctypes.c_int * 2)(8, 3)
+    assert lib.MXTPUNDArrayReshape(hx, 2, dims, ctypes.byref(hr)) == 0, err()
+    rs = np.zeros((8, 3), np.float32)
+    assert lib.MXTPUNDArraySyncCopyToCPU(
+        hr, rs.ctypes.data_as(ctypes.c_void_p), rs.nbytes) == 0
+    np.testing.assert_array_equal(rs, xv.reshape(8, 3))
+    dt, di = ctypes.c_int(), ctypes.c_int()
+    assert lib.MXTPUNDArrayGetContext(
+        hx, ctypes.byref(dt), ctypes.byref(di)) == 0, err()
+    assert dt.value == 1  # cpu
+
+    # copy: hx -> fresh buffer
+    hc = c_array(np.zeros_like(xv))
+    assert lib.MXTPUNDArrayCopyFromTo(hx, hc) == 0, err()
+    cp = np.zeros_like(xv)
+    assert lib.MXTPUNDArraySyncCopyToCPU(
+        hc, cp.ctypes.data_as(ctypes.c_void_p), cp.nbytes) == 0
+    np.testing.assert_array_equal(cp, xv)
+
+    # executor reshape to batch 2 and forward again
+    rkeys = (ctypes.c_char_p * 1)(b"data")
+    ndims = (ctypes.c_uint32 * 1)(2)
+    shape0 = (ctypes.c_uint32 * 2)(2, 6)
+    shape_ptrs = (ctypes.POINTER(ctypes.c_uint32) * 1)(shape0)
+    ex2 = ctypes.c_void_p()
+    assert lib.MXTPUExecutorReshape(ex, 1, rkeys, ndims, shape_ptrs,
+                                    ctypes.byref(ex2)) == 0, err()
+    assert lib.MXTPUExecutorForward(ex2, 0) == 0, err()
+
+    # compose error surfaces through GetLastError
+    bad = ctypes.c_void_p()
+    assert lib.MXTPUSymbolCreateAtomicSymbol(
+        b"NoSuchOp", 0, None, None, ctypes.byref(bad)) == 0, err()
+    rc = lib.MXTPUSymbolCompose(bad, b"x", 1, ckeys, args)
+    assert rc != 0 and "NoSuchOp" in err()
+
+    # an uncomposed atomic handle gives a meaningful error elsewhere
+    rc = lib.MXTPUSymbolListArguments(bad, ctypes.byref(n),
+                                      ctypes.byref(names))
+    assert rc != 0 and "uncomposed" in err()
+
+    # out-of-range slice errors instead of silently clamping
+    hbad = ctypes.c_void_p()
+    rc = lib.MXTPUNDArraySlice(hx, 0, 100, ctypes.byref(hbad))
+    assert rc != 0 and "invalid slice" in err()
+
+    # compose also wires free variables of a REAL (JSON-loaded) symbol
+    json_sym = ctypes.c_void_p()
+    assert lib.MXTPUSymbolSaveToJSON(fc, ctypes.byref(out)) == 0, err()
+    assert lib.MXTPUSymbolCreateFromJSON(
+        out.value, ctypes.byref(json_sym)) == 0, err()
+    scaled = ctypes.c_void_p()
+    k2 = (ctypes.c_char_p * 2)(b"data", b"scalar")
+    v2 = (ctypes.c_char_p * 2)(b"", b"2.0")
+    # graft: data := data * 2 via an atomic _mul_scalar, composed into
+    # the loaded graph's free 'data' variable
+    assert lib.MXTPUSymbolCreateAtomicSymbol(
+        b"_mul_scalar", 1, (ctypes.c_char_p * 1)(b"scalar"),
+        (ctypes.c_char_p * 1)(b"2.0"), ctypes.byref(scaled)) == 0, err()
+    assert lib.MXTPUSymbolCompose(scaled, b"x2", 1, ckeys, args) == 0, err()
+    sub_args = (ctypes.c_void_p * 1)(scaled)
+    assert lib.MXTPUSymbolCompose(json_sym, b"", 1, ckeys,
+                                  sub_args) == 0, err()
+    ex3 = ctypes.c_void_p()
+    assert lib.MXTPUExecutorBind(json_sym, 1, 0, 2, arg_handles, None,
+                                 None, 0, None, ctypes.byref(ex3)) == 0, \
+        err()
+    assert lib.MXTPUExecutorForward(ex3, 0) == 0, err()
+    n3 = ctypes.c_uint32()
+    outs3 = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXTPUExecutorOutputs(
+        ex3, ctypes.byref(n3), ctypes.byref(outs3)) == 0, err()
+    got3 = np.zeros((4, 3), np.float32)
+    assert lib.MXTPUNDArraySyncCopyToCPU(
+        ctypes.c_void_p(outs3[0]),
+        got3.ctypes.data_as(ctypes.c_void_p), got3.nbytes) == 0
+    np.testing.assert_allclose(got3, (2 * xv) @ wv.T, rtol=1e-4,
+                               atol=1e-5)
