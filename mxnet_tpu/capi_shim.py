@@ -548,9 +548,10 @@ def sym_compose(hid, name, keys, arg_hids):
         else:
             composed = op(*args, **attrs)
     else:
-        if not keys:
-            keys = list(target.list_arguments())[:len(args)]
-        composed = target.compose(**dict(zip(keys, args)))
+        # delegate to Symbol.__call__ so the positional mapping (and its
+        # arity validation) lives in exactly one place
+        composed = (target(**dict(zip(keys, args))) if keys
+                    else target(*args))
     _symbols.replace(hid, composed)
 
 

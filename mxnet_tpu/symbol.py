@@ -150,6 +150,27 @@ class Symbol:
 
         return Symbol([sub_input(o) for o in self._outputs])
 
+    def __call__(self, *args, **kwargs) -> "Symbol":
+        """Compose on inputs — ``x(y, z)`` / ``x(data=y)`` (reference
+        symbol.py:212-230). Positional args map to ``list_arguments``
+        order; mixing positional and keyword raises like the reference.
+        Returns a NEW symbol (this one is untouched — symbols here are
+        immutable, so copy-then-mutate collapses to just compose)."""
+        kwargs.pop("name", None)  # accepted for API parity; composition
+        # here rewires a DAG whose nodes keep their own names
+        if args and kwargs:
+            raise TypeError(
+                "compose only accepts input Symbols either as positional "
+                "or keyword arguments, not both")
+        if args:
+            free = self.list_arguments()
+            if len(args) > len(free):
+                raise TypeError(
+                    "compose got %d positional inputs for %d free "
+                    "arguments %s" % (len(args), len(free), free))
+            kwargs = dict(zip(free, args))
+        return self.compose(**kwargs)
+
     def get_internals(self) -> "Symbol":
         entries = []
         for n in self._nodes():

@@ -5,6 +5,7 @@ import os
 import tempfile
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import sym
@@ -183,3 +184,31 @@ def test_load_legacy_pre_nnvm_json_reference_fixture():
     with open(path) as f:
         net = mx.sym.load_json(f.read())
     _check_legacy_graph(net, 100)
+
+
+def test_call_composition():
+    """Reference symbol.py:212-230: x(y) / x(data=y) composes inputs."""
+    import numpy as np
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                no_bias=True, name="fc")
+    pre = mx.sym.Variable("raw") * 3.0
+    composed = net(data=pre)
+    assert composed.list_arguments() == ["raw", "fc_weight"]
+    ex = composed.simple_bind(mx.cpu(), raw=(2, 4), grad_req="null")
+    w = np.random.RandomState(0).randn(2, 4).astype("f")
+    ex.arg_dict["fc_weight"][:] = w
+    x = np.ones((2, 4), "f")
+    out = ex.forward(raw=x)[0].asnumpy()
+    np.testing.assert_allclose(out, (3 * x) @ w.T, rtol=1e-5)
+
+    # positional maps to list_arguments order; mixing raises
+    composed2 = net(pre)
+    assert composed2.tojson() == composed.tojson()
+    with pytest.raises(TypeError, match="not both"):
+        net(pre, data=pre)
+    with pytest.raises(TypeError, match="positional inputs"):
+        net(pre, pre, pre)
+    # unknown names raise (compose contract)
+    with pytest.raises(ValueError, match="not free arguments"):
+        net(nonexistent=pre)
