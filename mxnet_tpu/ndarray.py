@@ -177,6 +177,27 @@ class NDArray:
 
         return NDArray(self._data.reshape(_reshape_target(self.shape, shape)), self._ctx)
 
+    def broadcast_to(self, shape) -> "NDArray":
+        """Broadcast along extent-1 axes to ``shape`` (reference
+        ndarray.py broadcast_to). A shorter current shape is left-padded
+        with 1s like the reference; 0 in the target keeps the input
+        extent (the registered op's convention — this method delegates
+        to it so the two surfaces cannot diverge)."""
+        shape = tuple(int(d) for d in shape)
+        cur = self
+        if len(self.shape) < len(shape):
+            cur = self.reshape(
+                (1,) * (len(shape) - len(self.shape)) + self.shape)
+        if len(cur.shape) != len(shape):
+            raise ValueError("cannot broadcast %s to lower-rank %s"
+                             % (self.shape, shape))
+        if any(c != t and c != 1 and t != 0
+               for c, t in zip(cur.shape, shape)):
+            raise ValueError(
+                "cannot broadcast %s to %s (only extent-1 axes "
+                "broadcast)" % (self.shape, shape))
+        return _invoke("broadcast_to", (cur,), {"shape": shape})
+
     @property
     def T(self) -> "NDArray":
         return NDArray(self._data.T, self._ctx)

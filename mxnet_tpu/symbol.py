@@ -171,6 +171,27 @@ class Symbol:
             kwargs = dict(zip(free, args))
         return self.compose(**kwargs)
 
+    def debug_str(self) -> str:
+        """Readable graph dump (reference symbol.py debug_str —> nnvm
+        PrintGraphIR): one line per node with op, name, and inputs."""
+        lines = []
+        for n in self._nodes():
+            if n.is_variable:
+                lines.append("Variable:%s" % n.name)
+            else:
+                ins = ", ".join("%s[%d]" % (p.name, i) for p, i in n.inputs)
+                # same filter as attr_dict(): op params only, so the dump
+                # agrees with the JSON/attr view of the node
+                shown = {k: v for k, v in attrs_to_strs(n.attrs).items()
+                         if k in n.op.params}
+                attrs = ", ".join("%s=%s" % kv for kv in sorted(shown.items()))
+                lines.append("Op:%s, Name=%s%s%s" % (
+                    n.op.name, n.name,
+                    ("\n  Inputs: %s" % ins) if ins else "",
+                    ("\n  Attrs: %s" % attrs) if attrs else ""))
+        lines.append("Outputs: %s" % ", ".join(self.list_outputs()))
+        return "\n".join(lines)
+
     def get_internals(self) -> "Symbol":
         entries = []
         for n in self._nodes():

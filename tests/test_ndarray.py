@@ -154,3 +154,24 @@ def test_onehot_encode():
     idx = nd.array([0.0, 2.0])
     out = nd.one_hot(idx, depth=3)
     assert_almost_equal(out, np.eye(3, dtype=np.float32)[[0, 2]])
+
+
+def test_broadcast_to_method():
+    a = mx.nd.array(np.arange(3).reshape(1, 3))
+    b = a.broadcast_to((4, 3))
+    assert b.shape == (4, 3)
+    np.testing.assert_array_equal(b.asnumpy(), np.tile(np.arange(3), (4, 1)))
+
+    with pytest.raises(ValueError, match="broadcast"):
+        a.broadcast_to((4, 5))
+    with pytest.raises(ValueError, match="broadcast"):
+        a.broadcast_to((3,))
+
+
+def test_broadcast_to_rank_extension_and_zero():
+    # reference semantics: shorter shapes left-pad with 1s; 0 keeps dim
+    a = mx.nd.array(np.arange(3))
+    b = a.broadcast_to((4, 3))
+    assert b.shape == (4, 3)
+    c = mx.nd.array(np.arange(3).reshape(1, 3)).broadcast_to((5, 0))
+    assert c.shape == (5, 3)

@@ -212,3 +212,11 @@ def test_call_composition():
     # unknown names raise (compose contract)
     with pytest.raises(ValueError, match="not free arguments"):
         net(nonexistent=pre)
+
+
+def test_debug_str():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                name="fc")
+    s = net.debug_str()
+    assert "Variable:data" in s and "Name=fc" in s
+    assert "num_hidden=2" in s and "Outputs:" in s
