@@ -79,6 +79,14 @@ def _topo_sort(heads: Sequence[Tuple[_Node, int]]) -> List[_Node]:
     return order
 
 
+
+def _op_param_strs(node) -> Dict[str, str]:
+    """Node attrs filtered to the op's declared params, stringified —
+    the ONE filter debug_str / attr_dict / JSON save all share."""
+    return {k: v for k, v in attrs_to_strs(node.attrs).items()
+            if k in node.op.params}
+
+
 class Symbol:
     __slots__ = ("_outputs",)
 
@@ -180,11 +188,9 @@ class Symbol:
                 lines.append("Variable:%s" % n.name)
             else:
                 ins = ", ".join("%s[%d]" % (p.name, i) for p, i in n.inputs)
-                # same filter as attr_dict(): op params only, so the dump
-                # agrees with the JSON/attr view of the node
-                shown = {k: v for k, v in attrs_to_strs(n.attrs).items()
-                         if k in n.op.params}
-                attrs = ", ".join("%s=%s" % kv for kv in sorted(shown.items()))
+                attrs = ", ".join(
+                    "%s=%s" % kv
+                    for kv in sorted(_op_param_strs(n).items()))
                 lines.append("Op:%s, Name=%s%s%s" % (
                     n.op.name, n.name,
                     ("\n  Inputs: %s" % ins) if ins else "",

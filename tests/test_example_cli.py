@@ -1,0 +1,54 @@
+"""Smoke the BASELINE example CLIs as real subprocesses — the exact
+entry points a migrating user runs (reference configs:
+train_mnist.py, lstm_bucketing.py, model-parallel lstm; train_imagenet
+and train_ssd are exercised by test_real_data_e2e/test_detection_io).
+Tiny shapes; asserts exit 0 and a sane final log line, not accuracy
+(the convergence gates live in the module/e2e tests)."""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(rel, args, timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, rel)] + args,
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=ROOT)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-1200:]
+    return proc.stdout + proc.stderr
+
+
+def test_train_mnist_cli():
+    out = _run("examples/image_classification/train_mnist.py",
+               ["--network", "mlp", "--num-epochs", "1",
+                "--num-examples", "600", "--batch-size", "50",
+                "--lr", "0.2"])
+    assert "accuracy" in out.lower()
+
+
+def test_lstm_bucketing_cli():
+    out = _run("examples/rnn/lstm_bucketing.py",
+               ["--num-epochs", "1", "--num-hidden", "32",
+                "--num-embed", "32", "--batch-size", "16"])
+    assert "perplexity" in out.lower() or "ppl" in out.lower()
+
+
+def test_model_parallel_lstm_cli():
+    out = _run("examples/model_parallel_lstm/lstm.py",
+               ["--num-epochs", "1", "--num-hidden", "32",
+                "--num-embed", "32", "--batch-size", "16"])
+    assert "epoch" in out.lower()
+
+
+def test_train_lm_cli_benchmark():
+    out = _run("examples/transformer/train_lm.py",
+               ["--benchmark", "1", "--seq-len", "128", "--hidden", "64",
+                "--num-layers", "1", "--num-heads", "2",
+                "--batch-size", "2", "--num-steps", "2", "--warmup", "1",
+                "--vocab-size", "128"])
+    assert "tokens_per_sec" in out
