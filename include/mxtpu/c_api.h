@@ -34,6 +34,12 @@ typedef uint32_t mx_uint;
 
 const char* MXTPUGetLastError(void);
 
+/* Declared input order for one op (reference analogue:
+ * MXSymbolGetAtomicSymbolInfo's arg descriptions). Name table is
+ * thread-local storage, valid until the next call. */
+int MXTPUListOpInputs(const char* op_name, mx_uint* out_size,
+                      const char*** out_array);
+
 /* Library version string (mx.__version__); thread-local storage. */
 int MXTPUGetVersion(const char** out);
 /* Seed the global RNG resource (reference MXRandomSeed). */

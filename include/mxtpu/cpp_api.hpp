@@ -1,0 +1,335 @@
+/* Header-only C++ frontend over the flat C ABI — the cpp-package
+ * equivalent (reference cpp-package/include/mxnet-cpp/*.hpp: NDArray,
+ * Symbol, Operator, Executor RAII wrappers over c_api.h; the reference
+ * generates per-op wrappers with OpWrapperGenerator.py, here the
+ * Operator class reaches every registered op by name, which is also how
+ * the reference's generated wrappers work underneath).
+ *
+ * Link against libmxtpu_capi.so; see tests/test_c_api.py's
+ * test_cpp_frontend for the compile line and examples/cpp/train.cpp
+ * for a full train-a-step demo. */
+#ifndef MXTPU_CPP_API_HPP_
+#define MXTPU_CPP_API_HPP_
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxtpu/c_api.h"
+
+namespace mxtpu {
+
+using mx_uint = uint32_t;
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXTPUGetLastError());
+}
+
+inline std::string Version() {
+  const char* v = nullptr;
+  Check(MXTPUGetVersion(&v));
+  return v;
+}
+
+inline void RandomSeed(int seed) { Check(MXTPURandomSeed(seed)); }
+
+struct Context {
+  int dev_type, dev_id;
+  static Context Cpu(int id = 0) { return {1, id}; }
+  /* accelerator device (TPU in production; reference dev_type 2) */
+  static Context Tpu(int id = 0) { return {2, id}; }
+};
+
+class NDArray {
+ public:
+  NDArray() = default;
+  NDArray(const std::vector<mx_uint>& shape, Context ctx = Context::Cpu(),
+          int dtype_flag = 0) {
+    NDArrayHandle h = nullptr;
+    Check(MXTPUNDArrayCreate(shape.data(),
+                             static_cast<mx_uint>(shape.size()),
+                             ctx.dev_type, ctx.dev_id, dtype_flag, &h));
+    reset(h);
+  }
+  static NDArray FromData(const std::vector<float>& data,
+                          const std::vector<mx_uint>& shape,
+                          Context ctx = Context::Cpu()) {
+    NDArray a(shape, ctx);
+    Check(MXTPUNDArraySyncCopyFromCPU(a.handle(), data.data(),
+                                      data.size() * sizeof(float)));
+    return a;
+  }
+
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint* dims = nullptr;
+    Check(MXTPUNDArrayGetShape(handle(), &ndim, &dims));
+    return std::vector<mx_uint>(dims, dims + ndim);
+  }
+  size_t Size() const {
+    size_t n = 1;
+    for (auto d : Shape()) n *= d;
+    return n;
+  }
+  std::vector<float> ToVector() const {
+    std::vector<float> out(Size());
+    Check(MXTPUNDArraySyncCopyToCPU(handle(), out.data(),
+                                    out.size() * sizeof(float)));
+    return out;
+  }
+  NDArray Slice(mx_uint begin, mx_uint end) const {
+    NDArrayHandle h = nullptr;
+    Check(MXTPUNDArraySlice(handle(), begin, end, &h));
+    NDArray a;
+    a.reset(h);
+    return a;
+  }
+  NDArray Reshape(const std::vector<int>& dims) const {
+    NDArrayHandle h = nullptr;
+    Check(MXTPUNDArrayReshape(handle(), static_cast<int>(dims.size()),
+                              dims.data(), &h));
+    NDArray a;
+    a.reset(h);
+    return a;
+  }
+  void CopyTo(const NDArray& dst) const {
+    Check(MXTPUNDArrayCopyFromTo(handle(), dst.handle()));
+  }
+
+  NDArrayHandle handle() const { return h_.get(); }
+  void reset(NDArrayHandle h) {
+    h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXTPUNDArrayFree(p);
+    });
+  }
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
+class Symbol {
+ public:
+  Symbol() = default;
+  static Symbol Variable(const std::string& name) {
+    SymbolHandle h = nullptr;
+    Check(MXTPUSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+  static Symbol FromJSON(const std::string& json) {
+    SymbolHandle h = nullptr;
+    Check(MXTPUSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+  static Symbol FromFile(const std::string& fname) {
+    SymbolHandle h = nullptr;
+    Check(MXTPUSymbolCreateFromFile(fname.c_str(), &h));
+    return Symbol(h);
+  }
+
+  std::string ToJSON() const {
+    const char* js = nullptr;
+    Check(MXTPUSymbolSaveToJSON(handle(), &js));
+    return js;
+  }
+  std::vector<std::string> ListArguments() const {
+    return names_of(&MXTPUSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return names_of(&MXTPUSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return names_of(&MXTPUSymbolListAuxiliaryStates);
+  }
+  /* Wire named inputs (works on atomic AND loaded symbols — the C
+   * Compose contract). */
+  void Compose(const std::string& name,
+               const std::map<std::string, Symbol>& kwargs) {
+    std::vector<const char*> keys;
+    std::vector<SymbolHandle> args;
+    for (auto& kv : kwargs) {
+      keys.push_back(kv.first.c_str());
+      args.push_back(kv.second.handle());
+    }
+    Check(MXTPUSymbolCompose(handle(), name.c_str(),
+                             static_cast<mx_uint>(args.size()),
+                             keys.data(), args.data()));
+  }
+
+  SymbolHandle handle() const { return h_.get(); }
+  explicit Symbol(SymbolHandle h) {
+    h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXTPUSymbolFree(p);
+    });
+  }
+
+ private:
+  template <typename F>
+  std::vector<std::string> names_of(F fn) const {
+    mx_uint n = 0;
+    const char** arr = nullptr;
+    Check(fn(handle(), &n, &arr));
+    std::vector<std::string> out;
+    for (mx_uint i = 0; i < n; ++i) out.emplace_back(arr[i]);
+    return out;
+  }
+  std::shared_ptr<void> h_;
+};
+
+/* Reference cpp-package Operator (operator.hpp): name an op, set string
+ * params, then either CreateSymbol (graph mode) or Invoke (imperative). */
+class Operator {
+ public:
+  explicit Operator(const std::string& op_name) : op_(op_name) {}
+
+  template <typename T>
+  Operator& SetParam(const std::string& key, const T& value) {
+    std::ostringstream os;
+    os << value;
+    params_[key] = os.str();
+    return *this;
+  }
+  Operator& SetInput(const std::string& name, const Symbol& sym) {
+    for (auto& kv : sym_inputs_) {
+      if (kv.first == name)
+        throw std::runtime_error("duplicate input name '" + name +
+                                 "' for op " + op_);
+    }
+    sym_inputs_.emplace_back(name, sym);
+    return *this;
+  }
+  /* Named imperative input: Invoke() orders operands by the op's
+   * DECLARED input order (MXTPUListOpInputs), so call order does not
+   * matter and unknown names fail loudly. */
+  Operator& SetInput(const std::string& name, const NDArray& nd) {
+    for (auto& kv : nd_inputs_) {
+      if (kv.first == name)
+        throw std::runtime_error("duplicate input name '" + name +
+                                 "' for op " + op_);
+    }
+    nd_inputs_.emplace_back(name, nd);
+    return *this;
+  }
+  /* Positional imperative input (appended in call order). */
+  Operator& AddInput(const NDArray& nd) {
+    nd_inputs_.emplace_back("", nd);
+    return *this;
+  }
+
+  Symbol CreateSymbol(const std::string& name = "") {
+    std::vector<const char*> keys, vals;
+    for (auto& kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    SymbolHandle h = nullptr;
+    Check(MXTPUSymbolCreateAtomicSymbol(
+        op_.c_str(), static_cast<mx_uint>(keys.size()), keys.data(),
+        vals.data(), &h));
+    Symbol sym(h);
+    std::map<std::string, Symbol> kwargs;
+    for (auto& kv : sym_inputs_) kwargs.emplace(kv.first, kv.second);
+    sym.Compose(name, kwargs);
+    return sym;
+  }
+
+  std::vector<NDArray> Invoke() {
+    std::vector<NDArrayHandle> ins;
+    bool named = !nd_inputs_.empty() && !nd_inputs_.front().first.empty();
+    if (named) {
+      mx_uint n = 0;
+      const char** order = nullptr;
+      Check(MXTPUListOpInputs(op_.c_str(), &n, &order));
+      std::vector<std::string> want(order, order + n);
+      for (auto& name : want) {
+        for (auto& kv : nd_inputs_) {
+          if (kv.first == name) ins.push_back(kv.second.handle());
+        }
+      }
+      if (ins.size() != nd_inputs_.size()) {
+        std::string msg = "op " + op_ + " inputs are [";
+        for (auto& w : want) msg += w + " ";
+        throw std::runtime_error(msg + "]; got unknown/missing names");
+      }
+    } else {
+      for (auto& a : nd_inputs_) ins.push_back(a.second.handle());
+    }
+    std::vector<const char*> keys, vals;
+    for (auto& kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    int n_out = 0;
+    NDArrayHandle* outs = nullptr;
+    Check(MXTPUImperativeInvoke(op_.c_str(),
+                                static_cast<int>(ins.size()), ins.data(),
+                                &n_out, &outs,
+                                static_cast<int>(keys.size()),
+                                keys.data(), vals.data()));
+    std::vector<NDArray> result(n_out);
+    for (int i = 0; i < n_out; ++i) result[i].reset(outs[i]);
+    MXTPUFreeHandleArray(outs);
+    return result;
+  }
+
+ private:
+  std::string op_;
+  std::map<std::string, std::string> params_;
+  std::vector<std::pair<std::string, Symbol>> sym_inputs_;
+  std::vector<std::pair<std::string, NDArray>> nd_inputs_;
+};
+
+class Executor {
+ public:
+  /* grad_reqs: 0 null, 1 write, 3 add (reference OpReqType). */
+  Executor(const Symbol& sym, Context ctx,
+           const std::vector<NDArray>& args,
+           const std::vector<NDArray>& grads = {},
+           const std::vector<mx_uint>& grad_reqs = {},
+           const std::vector<NDArray>& aux = {}) {
+    std::vector<NDArrayHandle> ah, gh, xh;
+    for (auto& a : args) ah.push_back(a.handle());
+    for (auto& g : grads) gh.push_back(g.handle());
+    for (auto& x : aux) xh.push_back(x.handle());
+    ExecutorHandle h = nullptr;
+    Check(MXTPUExecutorBind(sym.handle(), ctx.dev_type, ctx.dev_id,
+                            static_cast<mx_uint>(ah.size()), ah.data(),
+                            gh.empty() ? nullptr : gh.data(),
+                            grad_reqs.empty() ? nullptr : grad_reqs.data(),
+                            static_cast<mx_uint>(xh.size()),
+                            xh.empty() ? nullptr : xh.data(), &h));
+    h_ = std::shared_ptr<void>(h, [](void* p) {
+      if (p) MXTPUExecutorFree(p);
+    });
+  }
+
+  void Forward(bool is_train) {
+    Check(MXTPUExecutorForward(h_.get(), is_train ? 1 : 0));
+  }
+  void Backward(const std::vector<NDArray>& head_grads = {}) {
+    std::vector<NDArrayHandle> hh;
+    for (auto& g : head_grads) hh.push_back(g.handle());
+    Check(MXTPUExecutorBackward(h_.get(),
+                                static_cast<mx_uint>(hh.size()),
+                                hh.empty() ? nullptr : hh.data()));
+  }
+  std::vector<NDArray> Outputs() const {
+    mx_uint n = 0;
+    NDArrayHandle* outs = nullptr;
+    Check(MXTPUExecutorOutputs(h_.get(), &n, &outs));
+    std::vector<NDArray> result(n);
+    for (mx_uint i = 0; i < n; ++i) result[i].reset(outs[i]);
+    MXTPUFreeHandleArray(outs);
+    return result;
+  }
+
+ private:
+  std::shared_ptr<void> h_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_API_HPP_

@@ -202,6 +202,15 @@ def list_op_names():
     return sorted(list_ops())
 
 
+def op_input_names(op_name):
+    """Declared input order for one op (MXTPUListOpInputs — the
+    reference exposes this via MXSymbolGetAtomicSymbolInfo's arg
+    descriptions)."""
+    from .ops.registry import get_op
+
+    return list(get_op(op_name).input_names({}))
+
+
 def nd_invoke(op_name, in_hids, keys, vals):
     """MXImperativeInvoke: attrs arrive as strings; the op's declarative
     Param specs parse them (the reference's attr_parser contract)."""

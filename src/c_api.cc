@@ -246,6 +246,15 @@ int MXTPUListAllOpNames(mx_uint* out_size, const char*** out_array) {
   return fill_name_table(res, out_size, out_array);
 }
 
+int MXTPUListOpInputs(const char* op_name, mx_uint* out_size,
+                      const char*** out_array) {
+  ensure_python();
+  GIL gil;
+  PyObject* res = call_shim("op_input_names", "(s)", op_name);
+  if (!res) return -1;
+  return fill_name_table(res, out_size, out_array);
+}
+
 int MXTPUImperativeInvoke(const char* op_name, int num_inputs, void** inputs,
                           int* num_outputs, void*** outputs, int num_params,
                           const char** param_keys, const char** param_vals) {

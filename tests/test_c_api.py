@@ -24,6 +24,33 @@ def _build_lib():
     return lib
 
 
+def _compile_and_run(src, run_args, compiler="gcc", timeout=240,
+                     std=None, check_output=True):
+    """Compile an examples/ program against libmxtpu_capi.so and run it
+    (one copy of the link/rpath/env boilerplate for all embedder tests)."""
+    import sysconfig
+    import tempfile
+
+    _build_lib()
+    libdir = sysconfig.get_config_var("LIBDIR")
+    with tempfile.TemporaryDirectory() as d:
+        exe = os.path.join(d, "prog")
+        cmd = [compiler, "-O2"] + (["-std=" + std] if std else []) + [
+            os.path.join(ROOT, src),
+            "-I", os.path.join(ROOT, "include"),
+            "-L", os.path.join(ROOT, "mxnet_tpu"), "-lmxtpu_capi",
+            "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu"),
+            "-Wl,-rpath," + libdir, "-o", exe]
+        res = subprocess.run(cmd, capture_output=True, text=True)
+        assert res.returncode == 0, res.stderr[-1200:]
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT}
+        run = subprocess.run([exe] + run_args, capture_output=True,
+                             text=True, timeout=timeout, env=env)
+    if check_output:
+        assert run.returncode == 0, (run.stdout, run.stderr)
+    return run
+
+
 def _train_checkpoint(tmp_path):
     np.random.seed(3)
     X = np.random.randn(60, 6).astype(np.float32)
@@ -124,27 +151,9 @@ def test_c_predict_error_reporting(tmp_path):
 def test_standalone_c_embedder(tmp_path):
     """Compile and run a real C program against the ABI: the process starts
     with no Python; the library embeds the interpreter itself."""
-    lib = _build_lib()  # ensure the .so exists
-    del lib
     prefix, X = _train_checkpoint(tmp_path)
-    exe = str(tmp_path / "demo")
-    import sysconfig
-
-    libdir = sysconfig.get_config_var("LIBDIR")
-    res = subprocess.run(
-        ["gcc", "-O2", os.path.join(ROOT, "examples", "c_predict", "demo.c"),
-         "-I", os.path.join(ROOT, "include"),
-         "-L", os.path.join(ROOT, "mxnet_tpu"), "-lmxtpu_capi",
-         "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu"),
-         "-Wl,-rpath," + libdir, "-o", exe],
-        capture_output=True, text=True)
-    assert res.returncode == 0, res.stderr
-    env = {**os.environ, "JAX_PLATFORMS": "cpu",
-           "PYTHONPATH": ROOT}
-    run = subprocess.run([exe, str(tmp_path / "m"), "3", "10", "6"],
-                         capture_output=True, text=True, timeout=240,
-                         env=env)
-    assert run.returncode == 0, (run.stdout, run.stderr)
+    run = _compile_and_run(os.path.join("examples", "c_predict", "demo.c"),
+                           [str(tmp_path / "m"), "3", "10", "6"])
     row = [float(v) for v in run.stdout.strip().split(",")]
     assert len(row) == 2 and abs(sum(row) - 1.0) < 1e-4  # softmax row
 
@@ -368,27 +377,10 @@ def test_standalone_c_symbol_executor_demo(tmp_path):
     """demo_symbol.c: a no-Python C program builds the graph from JSON,
     binds checkpoint weights via the symbol/executor ABI and classifies;
     its output must match the Python predictor on the same batch."""
-    lib = _build_lib()
-    del lib
     prefix, X = _train_checkpoint(tmp_path)
-    exe = str(tmp_path / "demo_symbol")
-    import sysconfig
-
-    libdir = sysconfig.get_config_var("LIBDIR")
-    res = subprocess.run(
-        ["gcc", "-O2",
-         os.path.join(ROOT, "examples", "c_predict", "demo_symbol.c"),
-         "-I", os.path.join(ROOT, "include"),
-         "-L", os.path.join(ROOT, "mxnet_tpu"), "-lmxtpu_capi",
-         "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu"),
-         "-Wl,-rpath," + libdir, "-o", exe],
-        capture_output=True, text=True)
-    assert res.returncode == 0, res.stderr
-    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT}
-    run = subprocess.run([exe, str(tmp_path / "m"), "3", "10", "6"],
-                         capture_output=True, text=True, timeout=240,
-                         env=env)
-    assert run.returncode == 0, (run.stdout, run.stderr)
+    run = _compile_and_run(
+        os.path.join("examples", "c_predict", "demo_symbol.c"),
+        [str(tmp_path / "m"), "3", "10", "6"])
     row = np.array([float(v) for v in run.stdout.strip().split(",")])
     assert row.shape == (2,) and abs(row.sum() - 1.0) < 1e-4
 
@@ -686,3 +678,13 @@ def test_c_graph_building_and_views():
         got3.ctypes.data_as(ctypes.c_void_p), got3.nbytes) == 0
     np.testing.assert_allclose(got3, (2 * xv) @ wv.T, rtol=1e-4,
                                atol=1e-5)
+
+
+def test_cpp_frontend(tmp_path):
+    """Compile and run the header-only C++ frontend demo (cpp-package
+    parity — reference cpp-package/include/mxnet-cpp + example/mlp.cpp):
+    Operator/Symbol graph building, Executor train loop, imperative
+    sgd_update, JSON round-trip, all from a C++ program."""
+    run = _compile_and_run(os.path.join("examples", "cpp", "train.cpp"),
+                           [], compiler="g++", std="c++17", timeout=300)
+    assert "cpp frontend ok" in run.stdout
