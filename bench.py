@@ -319,6 +319,27 @@ def _run_phase(phase, cli, timeout):
                                    "; ".join(tail[-2:])[:300])}
 
 
+def _probe_backend(timeout=300):
+    """Claim and release the backend in a subprocess. Returns None when
+    healthy, else a short error string."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import mxnet_tpu, jax; d = jax.devices();"
+             "x = jax.numpy.ones((8, 8)); (x @ x).block_until_ready();"
+             "print('probe-ok', d)"],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if "probe-ok" not in probe.stdout:
+            out = (probe.stderr or probe.stdout).strip()
+            raise RuntimeError(out.splitlines()[-1][:200] if out
+                               else "no output")
+    except (subprocess.TimeoutExpired, RuntimeError) as e:
+        return ("backend probe failed (unreachable TPU tunnel?): %s"
+                % (e,))[:300]
+    return None
+
+
 def orchestrate(argv=None):
     """Default CLI path: LM phase first (fast; provisional headline line
     printed immediately), then the ResNet phase, then the merged record.
@@ -330,24 +351,13 @@ def orchestrate(argv=None):
     # cheap liveness probe: a dead/wedged TPU tunnel (the BENCH_r04
     # failure mode) should cost 5 minutes, not the sum of both phase
     # timeouts. The probe claims and releases the chip before phase 1.
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import mxnet_tpu, jax; d = jax.devices();"
-             "x = jax.numpy.ones((8, 8)); (x @ x).block_until_ready();"
-             "print('probe-ok', d)"],
-            capture_output=True, text=True, timeout=300,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        if "probe-ok" not in probe.stdout:
-            raise RuntimeError((probe.stderr or probe.stdout)
-                               .strip().splitlines()[-1][:200]
-                               if (probe.stderr or probe.stdout).strip()
-                               else "no output")
-    except (subprocess.TimeoutExpired, RuntimeError) as e:
-        msg = ("backend probe failed (unreachable TPU tunnel?): %s"
-               % (e,))[:300]
-        record = {"metric": "transformer_lm_train_mfu", "value": 0.0,
-                  "unit": "MFU", "vs_baseline": 0.0, "error": msg}
+    def error_record(msg):
+        return {"metric": "transformer_lm_train_mfu", "value": 0.0,
+                "unit": "MFU", "vs_baseline": 0.0, "error": msg[:300]}
+
+    err = _probe_backend()
+    if err:
+        record = error_record(err)
         print(json.dumps(record))
         return record
 
@@ -355,6 +365,20 @@ def orchestrate(argv=None):
         record.update(_run_phase("lm", cli, cli.lm_timeout))
         if record.get("transformer_lm_mfu"):
             print(json.dumps(_headline(dict(record))), flush=True)
+        # the tunnel flaps mid-session (PERF.md round-5 timeline):
+        # re-probe before committing to the long ResNet phase, whether
+        # the LM phase succeeded or died
+        if _probe_backend():
+            if record.get("transformer_lm_mfu"):
+                record = _headline(record)
+                record["resnet_error"] = \
+                    "tunnel died after the LM phase; ResNet skipped"
+            else:
+                record = error_record(
+                    "tunnel died during the LM phase: %s"
+                    % record.get("lm_error"))
+            print(json.dumps(record))
+            return record
 
     resnet = _run_phase("resnet", cli, cli.resnet_timeout)
     metric_fields = {k: resnet.pop(k, None) for k in
