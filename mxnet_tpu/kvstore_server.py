@@ -45,27 +45,49 @@ from . import optimizer as opt
 __all__ = ["KVStoreServer", "start_server", "ServerClient",
            "_init_kvstore_server_module"]
 
-_HDR = struct.Struct("<Q")
+# wire: <payload_len, n_bufs> header, n_bufs buffer lengths, pickled
+# metadata, then the raw array buffers OUT OF BAND (pickle protocol 5
+# buffer_callback) — array bytes go straight from the caller's memory to
+# per-buffer sendall with no pickle-side copy; the copy was the measured
+# bottleneck of the dist_async plane at exactly the big-key sizes the
+# range split targets (PERF.md table)
+_HDR = struct.Struct("<QI")
+_LEN = struct.Struct("<Q")
 
 
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HDR.pack(len(payload)) + payload)
+    bufs = []
+    payload = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
+    raws = [b.raw() for b in bufs]
+    head = _HDR.pack(len(payload), len(raws))
+    lens = b"".join(_LEN.pack(r.nbytes) for r in raws)
+    sock.sendall(head + lens + payload)  # small metadata: one copy
+    for r in raws:                       # array bytes: zero-copy sendall
+        sock.sendall(r)
 
 
 def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError("peer closed")
-        buf += chunk
+        got += r
     return buf
 
 
 def _recv_msg(sock):
-    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return pickle.loads(_recv_exact(sock, n))
+    n, nbuf = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    lens = []
+    if nbuf:
+        raw = _recv_exact(sock, _LEN.size * nbuf)
+        lens = [_LEN.unpack_from(raw, i * _LEN.size)[0]
+                for i in range(nbuf)]
+    payload = _recv_exact(sock, n)
+    bufs = [_recv_exact(sock, ln) for ln in lens]
+    return pickle.loads(payload, buffers=bufs)
 
 
 class KVStoreServer:
