@@ -1,0 +1,105 @@
+"""One-process TPU capture for a flapping tunnel.
+
+The round-5 tunnel pattern (PERF.md): when half-healthy, the FIRST
+backend init in a window succeeds and later ones hang — so multi-process
+orchestration (probe, then phase subprocesses) burns the window on the
+probe. This script claims the chip ONCE and runs everything in that
+process, fastest-first, appending one JSON line per result so a mid-run
+tunnel death keeps everything already measured:
+
+  1. probe (device matmul)                       ~seconds
+  2. transformer-LM train step, flash backend    (the headline)
+  3. flash kernel at s=8k and at model shapes
+  4. splash oracle (ceiling calibration)
+  5. ResNet-50 Module benchmark                  (cold compile ~60-90min,
+                                                  cached in .jax_cache)
+
+A watchdog hard-exits (code 3) if the backend init hangs >8min — a dead
+tunnel costs minutes, and the process never wedges a watcher cycle.
+
+Usage: python tools/capture_once.py [--skip-resnet] >> capture.jsonl
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def emit(name, **kw):
+    print(json.dumps({"capture": name, "t": round(time.time(), 1), **kw}),
+          flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-resnet", action="store_true")
+    cli = ap.parse_args()
+
+    watchdog = threading.Timer(480, lambda: os._exit(3))
+    watchdog.daemon = True
+    watchdog.start()
+
+    import mxnet_tpu  # noqa: F401  (JAX_PLATFORMS honor + compile cache)
+    import jax
+
+    x = jax.numpy.ones((128, 128))
+    (x @ x).block_until_ready()
+    watchdog.cancel()
+    backend = jax.default_backend()
+    emit("probe", backend=backend,
+         device=str(jax.devices()[0]))
+    if backend != "tpu":
+        emit("abort", reason="backend %s is not tpu" % backend)
+        return 2
+
+    import bench
+
+    peak = 197e12
+    try:
+        lm = bench.transformer_lm_bench(attn_impl="flash")
+        emit("transformer_lm_flash",
+             tokens_per_sec=round(lm["tokens_per_sec"], 1),
+             tflops=round(lm["model_tflops"], 2),
+             mfu=round(lm["model_tflops"] * 1e12 / peak, 4))
+    except Exception as e:
+        emit("transformer_lm_flash", error=str(e)[:200])
+
+    from bench_attention import run_bench, run_oracle_bench
+
+    for name, kw in (
+            ("flash_kernel_8k", dict(seq=8192, steps=10, block_q=512,
+                                     block_k=1024)),
+            ("flash_kernel_model_shape", dict(batch=4, heads=16, seq=4096,
+                                              steps=10, block_q=512,
+                                              block_k=1024))):
+        try:
+            r = run_bench(**kw)
+            emit(name, tflops=r["value"], mfu=r["mfu"],
+                 step_ms=r["step_ms"])
+        except Exception as e:
+            emit(name, error=str(e)[:200])
+    try:
+        orc = run_oracle_bench(seq=8192, steps=5)
+        emit("splash_oracle", tflops=orc["value"], mfu=orc["mfu"])
+    except Exception as e:
+        emit("splash_oracle", error=str(e)[:200])
+
+    if not cli.skip_resnet:
+        try:
+            rn = bench.resnet_bench(bench._arg_parser().parse_args([]))
+            emit("resnet50", **{k: v for k, v in rn.items()
+                                if k != "metric"})
+        except Exception as e:
+            emit("resnet50", error=str(e)[:300])
+    emit("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
