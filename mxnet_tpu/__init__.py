@@ -89,6 +89,7 @@ from . import image
 from . import models
 from . import contrib
 from .predictor import Predictor, load_exported
+from . import serving
 from .ops import register_pallas_op, Param
 from . import rtc
 from . import torch as th

@@ -26,6 +26,7 @@ Eltwise, Flatten, Reshape, Split, Softmax(WithLoss).
 """
 from __future__ import annotations
 
+import logging
 import re
 from typing import Dict, List, Tuple
 
@@ -402,9 +403,23 @@ def convert_model(prototxt_text: str, caffemodel_bytes: bytes):
     layers = _aslist(net.get("layer")) or _aslist(net.get("layers"))
     by_name = {la.get("name"): la for la in layers}
     arg_params, aux_params = {}, {}
+    # the reference converter swaps channels 0/2 of the FIRST convolution's
+    # weight when it consumes 3/4-channel input (convert_model.py:68-71):
+    # Caffe pipelines feed BGR (OpenCV), mx pipelines RGB
+    first_conv = next((la.get("name") for la in layers
+                       if _norm_type(la.get("type")) == "Convolution"), None)
 
     for name, lblobs in blobs.items():
-        layer = by_name.get(name, {})
+        if name not in by_name:
+            # train-vs-deploy mismatch (loss-only or renamed layers):
+            # emitting params for them breaks bind/load of the converted
+            # symbol, so skip the blobs like the reference prototxt-driven
+            # converter implicitly does
+            logging.warning(
+                "caffe.convert_model: layer %r has blobs in the caffemodel "
+                "but is absent from the deploy prototxt; skipping", name)
+            continue
+        layer = by_name[name]
         ltype = _norm_type(layer.get("type"))
         if not lblobs:
             continue
@@ -441,7 +456,12 @@ def convert_model(prototxt_text: str, caffemodel_bytes: bytes):
                 arg_params[name + "_bias"] = nd.array(lblobs[1].ravel())
         else:
             # conv [out,in,kh,kw] layout matches mx
-            arg_params[name + "_weight"] = nd.array(lblobs[0])
+            wmat = lblobs[0]
+            if name == first_conv and wmat.ndim == 4 \
+                    and wmat.shape[1] in (3, 4):
+                wmat = wmat.copy()
+                wmat[:, [0, 2]] = wmat[:, [2, 0]]  # BGR -> RGB
+            arg_params[name + "_weight"] = nd.array(wmat)
             if len(lblobs) > 1:
                 arg_params[name + "_bias"] = nd.array(lblobs[1].ravel())
     return sym, arg_params, aux_params

@@ -45,12 +45,15 @@ from . import optimizer as opt
 __all__ = ["KVStoreServer", "start_server", "ServerClient",
            "_init_kvstore_server_module"]
 
-# wire: <payload_len, n_bufs> header, n_bufs buffer lengths, pickled
-# metadata, then the raw array buffers OUT OF BAND (pickle protocol 5
-# buffer_callback) — array bytes go straight from the caller's memory to
-# per-buffer sendall with no pickle-side copy; the copy was the measured
-# bottleneck of the dist_async plane at exactly the big-key sizes the
-# range split targets (PERF.md table)
+# wire: 1 version byte, <payload_len, n_bufs> header, n_bufs buffer
+# lengths, pickled metadata, then the raw array buffers OUT OF BAND
+# (pickle protocol 5 buffer_callback) — array bytes go straight from the
+# caller's memory to per-buffer sendall with no pickle-side copy; the copy
+# was the measured bottleneck of the dist_async plane at exactly the
+# big-key sizes the range split targets (PERF.md table).  The leading
+# version byte turns a mixed-version worker/server pair into a clear
+# error instead of a confusing unpickling failure mid-stream.
+_WIRE_VERSION = 1
 _HDR = struct.Struct("<QI")
 _LEN = struct.Struct("<Q")
 
@@ -58,8 +61,15 @@ _LEN = struct.Struct("<Q")
 def _send_msg(sock, obj):
     bufs = []
     payload = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
-    raws = [b.raw() for b in bufs]
-    head = _HDR.pack(len(payload), len(raws))
+    try:
+        raws = [b.raw() for b in bufs]
+    except BufferError:
+        # non-contiguous ndarray reached the wire (sliced/transposed
+        # views can't expose a flat buffer): fall back to in-band
+        # protocol-5 pickling, which copies into contiguous form
+        payload = pickle.dumps(obj, protocol=5)
+        raws = []
+    head = bytes([_WIRE_VERSION]) + _HDR.pack(len(payload), len(raws))
     lens = b"".join(_LEN.pack(r.nbytes) for r in raws)
     sock.sendall(head + lens + payload)  # small metadata: one copy
     for r in raws:                       # array bytes: zero-copy sendall
@@ -79,6 +89,12 @@ def _recv_exact(sock, n):
 
 
 def _recv_msg(sock):
+    ver = _recv_exact(sock, 1)[0]
+    if ver != _WIRE_VERSION:
+        raise ConnectionError(
+            "kvstore wire version mismatch: peer sent %d, this process "
+            "speaks %d — worker and server run different mxnet_tpu "
+            "builds" % (ver, _WIRE_VERSION))
     n, nbuf = _HDR.unpack(_recv_exact(sock, _HDR.size))
     lens = []
     if nbuf:

@@ -163,15 +163,27 @@ class MXIndexedRecordIO(MXRecordIO):
         super().open()
         self.idx = {}
         self.keys = []
-        if self.writable:
-            self.fidx = open_uri(self.idx_path, "w")
-        else:
-            self.fidx = open_uri(self.idx_path, "r")
-            for line in iter(self.fidx.readline, ""):
-                line = line.strip().split("\t")
-                key = self.key_type(line[0])
-                self.idx[key] = int(line[1])
-                self.keys.append(key)
+        try:
+            if self.writable:
+                self.fidx = open_uri(self.idx_path, "w")
+            else:
+                self.fidx = open_uri(self.idx_path, "r")
+                for line in iter(self.fidx.readline, ""):
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+        except Exception:
+            # a missing/broken sidecar .idx must not leak the record
+            # handle opened above (ImageIter's remote-URI fallback probes
+            # this path once per construction)
+            if self.fidx is not None:
+                try:
+                    self.fidx.close()
+                finally:
+                    self.fidx = None
+            super().close()
+            raise
 
     def close(self):
         super().close()

@@ -1,0 +1,18 @@
+"""mxnet_tpu.serving — dynamic-batching inference service over Predictor.
+
+The production serving tier (docs/how_to/serving.md): a request queue +
+micro-batcher that coalesces concurrent traffic into padded power-of-two
+bucket batches (pre-compiled at startup, so steady state never
+recompiles), a threaded front end with futures / bounded-queue
+backpressure / per-request deadlines / graceful drain, an optional
+stdlib-HTTP endpoint, and Prometheus-style metrics wired into the
+chrome-trace profiler.
+"""
+from .batcher import (BucketedPredictor, DeadlineExceededError, MicroBatcher,
+                      QueueFullError, ServerClosedError, pow2_buckets)
+from .metrics import ServingMetrics
+from .server import InferenceServer
+
+__all__ = ["InferenceServer", "BucketedPredictor", "MicroBatcher",
+           "ServingMetrics", "pow2_buckets", "QueueFullError",
+           "DeadlineExceededError", "ServerClosedError"]

@@ -1,0 +1,166 @@
+"""Serving metrics — QPS, latency quantiles, queue depth, batch histograms.
+
+The reference framework had no serving tier at all; this follows the
+conventions production model servers converged on (TF Serving / Triton):
+a small set of counters + histograms, exported in Prometheus text format,
+cheap enough to update on every request under a single lock.  Batches are
+additionally emitted as :class:`mxnet_tpu.profiler.Frame` spans, so a
+``profiler_set_state("run")`` / ``dump_profile()`` around serving traffic
+shows each flushed batch on the chrome-trace timeline next to the
+executor's own events.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict
+
+__all__ = ["ServingMetrics"]
+
+# sliding window for QPS, seconds
+_QPS_WINDOW = 60.0
+# bounded reservoir of per-request latencies for the quantile estimates
+_LATENCY_SAMPLES = 4096
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = q * (len(sorted_vals) - 1)
+    lo = int(idx)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = idx - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+class ServingMetrics:
+    """Thread-safe counters for one :class:`InferenceServer`.
+
+    ``batch_size_hist`` is keyed by the *bucket* (padded shape) each flush
+    ran at — its key set is exactly the set of distinct compiled shapes the
+    server exercised, and the sum of its counts is the number of underlying
+    executor invocations.  ``occupancy_hist`` is keyed by the number of
+    real (un-padded) requests in each flush.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.requests_total = 0
+        self.requests_rejected = 0
+        self.requests_expired = 0
+        self.requests_failed = 0
+        self.requests_completed = 0
+        self.batches_total = 0
+        self.padded_items_total = 0
+        self.queue_depth = 0
+        self.queue_depth_peak = 0
+        self.batch_size_hist: Dict[int, int] = {}
+        self.occupancy_hist: Dict[int, int] = {}
+        self._latencies = deque(maxlen=_LATENCY_SAMPLES)
+        self._completions = deque()  # monotonic stamps inside _QPS_WINDOW
+
+    # -- update hooks (called by the batcher/server) ----------------------
+    def on_submit(self, queue_depth):
+        with self._lock:
+            self.requests_total += 1
+            self.queue_depth = queue_depth
+            self.queue_depth_peak = max(self.queue_depth_peak, queue_depth)
+
+    def on_reject(self):
+        with self._lock:
+            self.requests_rejected += 1
+
+    def on_expire(self, n=1):
+        with self._lock:
+            self.requests_expired += n
+
+    def on_fail(self, n=1):
+        with self._lock:
+            self.requests_failed += n
+
+    def on_dequeue(self, queue_depth):
+        with self._lock:
+            self.queue_depth = queue_depth
+
+    def on_batch(self, bucket, occupancy):
+        with self._lock:
+            self.batches_total += 1
+            self.padded_items_total += bucket - occupancy
+            self.batch_size_hist[bucket] = \
+                self.batch_size_hist.get(bucket, 0) + 1
+            self.occupancy_hist[occupancy] = \
+                self.occupancy_hist.get(occupancy, 0) + 1
+
+    def on_complete(self, latency_ms):
+        now = time.monotonic()
+        with self._lock:
+            self.requests_completed += 1
+            self._latencies.append(latency_ms)
+            self._completions.append(now)
+            cutoff = now - _QPS_WINDOW
+            while self._completions and self._completions[0] < cutoff:
+                self._completions.popleft()
+
+    # -- export -----------------------------------------------------------
+    def qps(self):
+        now = time.monotonic()
+        with self._lock:
+            cutoff = now - _QPS_WINDOW
+            while self._completions and self._completions[0] < cutoff:
+                self._completions.popleft()
+            span = min(max(now - self._t0, 1e-9), _QPS_WINDOW)
+            return len(self._completions) / span
+
+    def snapshot(self):
+        """One consistent dict of everything (the JSON-side export)."""
+        qps = self.qps()
+        with self._lock:
+            lat = sorted(self._latencies)
+            return {
+                "requests_total": self.requests_total,
+                "requests_completed": self.requests_completed,
+                "requests_rejected": self.requests_rejected,
+                "requests_expired": self.requests_expired,
+                "requests_failed": self.requests_failed,
+                "batches_total": self.batches_total,
+                "padded_items_total": self.padded_items_total,
+                "queue_depth": self.queue_depth,
+                "queue_depth_peak": self.queue_depth_peak,
+                "batch_size_hist": dict(self.batch_size_hist),
+                "occupancy_hist": dict(self.occupancy_hist),
+                "latency_ms_p50": _percentile(lat, 0.50),
+                "latency_ms_p99": _percentile(lat, 0.99),
+                "qps": qps,
+            }
+
+    def render_text(self):
+        """Prometheus text exposition of :meth:`snapshot`."""
+        s = self.snapshot()
+        lines = []
+        for key in ("requests_total", "requests_completed",
+                    "requests_rejected", "requests_expired",
+                    "requests_failed", "batches_total",
+                    "padded_items_total"):
+            lines.append("# TYPE mxtpu_serving_%s counter" % key)
+            lines.append("mxtpu_serving_%s %d" % (key, s[key]))
+        lines.append("# TYPE mxtpu_serving_queue_depth gauge")
+        lines.append("mxtpu_serving_queue_depth %d" % s["queue_depth"])
+        lines.append("mxtpu_serving_queue_depth_peak %d"
+                     % s["queue_depth_peak"])
+        lines.append("# TYPE mxtpu_serving_batch_size histogram")
+        for b in sorted(s["batch_size_hist"]):
+            lines.append('mxtpu_serving_batch_size{bucket="%d"} %d'
+                         % (b, s["batch_size_hist"][b]))
+        for n in sorted(s["occupancy_hist"]):
+            lines.append('mxtpu_serving_batch_occupancy{n="%d"} %d'
+                         % (n, s["occupancy_hist"][n]))
+        lines.append("# TYPE mxtpu_serving_latency_ms summary")
+        lines.append('mxtpu_serving_latency_ms{quantile="0.5"} %.3f'
+                     % s["latency_ms_p50"])
+        lines.append('mxtpu_serving_latency_ms{quantile="0.99"} %.3f'
+                     % s["latency_ms_p99"])
+        lines.append("# TYPE mxtpu_serving_qps gauge")
+        lines.append("mxtpu_serving_qps %.3f" % s["qps"])
+        return "\n".join(lines) + "\n"

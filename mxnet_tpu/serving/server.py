@@ -1,0 +1,235 @@
+"""InferenceServer — the threaded serving front end over the micro-batcher.
+
+``submit()`` gives a ``concurrent.futures.Future`` per request (the
+in-process RPC surface); ``serve_http()`` optionally exposes the same
+thing as a small stdlib HTTP endpoint (JSON in/out, ``/metrics`` in
+Prometheus text format) so a converted checkpoint becomes a network
+service with zero extra dependencies.  Admission control is a bounded
+queue: beyond ``max_queue`` pending requests, ``submit`` raises
+:class:`QueueFullError` (HTTP 503) instead of letting latency grow
+without bound — callers retry with backoff, which is the backpressure
+contract.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError, env, register_env
+from ..context import Context
+from .batcher import (BucketedPredictor, DeadlineExceededError, MicroBatcher,
+                      QueueFullError, ServerClosedError, pow2_buckets)
+from .metrics import ServingMetrics
+
+__all__ = ["InferenceServer"]
+
+register_env("MXNET_SERVING_MAX_WAIT_US", 2000, int,
+             "Default micro-batch flush deadline for InferenceServer.")
+register_env("MXNET_SERVING_MAX_QUEUE", 256, int,
+             "Default admission-control queue bound for InferenceServer.")
+
+
+class InferenceServer:
+    """Dynamic-batching inference service over a (symbol, params) checkpoint.
+
+    Parameters
+    ----------
+    symbol, params, dtype
+        As for :class:`mxnet_tpu.Predictor`.
+    input_shapes : dict
+        ``{input_name: shape}`` INCLUDING the leading batch axis; the
+        leading dim of the first input is the default ``max_batch_size``
+        and per-request inputs carry the remaining dims.
+    ctx : Context | list of Context, optional
+        One replica (bucket-predictor family + worker thread) is built
+        per context, all pulling from one shared queue.
+    buckets : sequence of int, optional
+        Allowed padded batch sizes; default ``pow2_buckets(max_batch)``.
+    max_wait_us : int
+        Flush deadline: a queued request never waits longer than this for
+        its batch to fill.
+    max_queue : int
+        Admission bound; ``submit`` beyond it raises ``QueueFullError``.
+    warmup : bool
+        Pre-compile every bucket before accepting traffic (default True).
+    """
+
+    def __init__(self, symbol, params, input_shapes: Dict[str, Sequence[int]],
+                 ctx=None, buckets: Optional[Sequence[int]] = None,
+                 max_wait_us: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 dtype=np.float32, warmup: bool = True, start: bool = True):
+        shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        batch_dims = {s[0] for s in shapes.values() if len(s) >= 1}
+        if len(batch_dims) != 1:
+            raise MXNetError(
+                "all serving inputs must share one leading batch dim, got %s"
+                % shapes)
+        max_batch = batch_dims.pop()
+        if buckets is None:
+            buckets = pow2_buckets(max_batch)
+        self._item_shapes = {k: s[1:] for k, s in shapes.items()}
+        self._dtype = np.dtype(dtype)
+        ctxs = ctx if isinstance(ctx, (list, tuple)) else [ctx]
+        self._replicas = [
+            BucketedPredictor(symbol, params, self._item_shapes, buckets,
+                              ctx=c, dtype=dtype)
+            for c in ctxs]
+        self.buckets = self._replicas[0].buckets
+        self.metrics = ServingMetrics()
+        self._batcher = MicroBatcher(
+            self._replicas, self.metrics,
+            max_wait_us=env("MXNET_SERVING_MAX_WAIT_US", 2000, int)
+            if max_wait_us is None else max_wait_us,
+            max_queue=env("MXNET_SERVING_MAX_QUEUE", 256, int)
+            if max_queue is None else max_queue)
+        self._httpd = None
+        self._http_thread = None
+        if warmup:
+            for rep in self._replicas:
+                rep.warmup()
+        if start:
+            self.start()
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, input_shapes, **kwargs):
+        """Serve ``save_checkpoint`` files directly (the file pair
+        ``Predictor.from_checkpoint`` consumes)."""
+        return cls("%s-symbol.json" % prefix,
+                   "%s-%04d.params" % (prefix, epoch),
+                   input_shapes, **kwargs)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        self._batcher.start()
+        return self
+
+    def stop(self, drain: bool = True):
+        """Stop the service.  With ``drain`` (default) queued requests are
+        flushed before the workers exit; without it they fail fast with
+        :class:`ServerClosedError`.  Idempotent."""
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            if self._http_thread is not None:
+                self._http_thread.join(timeout=5)
+                self._http_thread = None
+        self._batcher.stop(drain=drain)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=True)
+
+    # -- request path -----------------------------------------------------
+    def _coerce(self, name, value):
+        shape = self._item_shapes.get(name)
+        if shape is None:
+            raise MXNetError("unknown input %r (expected %s)"
+                             % (name, sorted(self._item_shapes)))
+        arr = np.asarray(value, dtype=self._dtype)
+        if arr.shape == (1,) + shape:  # callers may keep a unit batch axis
+            arr = arr[0]
+        if arr.shape != shape:
+            raise MXNetError("input %r has shape %s, expected %s"
+                             % (name, arr.shape, shape))
+        return arr
+
+    def submit(self, deadline_ms: Optional[float] = None, **inputs) -> Future:
+        """Enqueue one request; returns a Future resolving to the per-item
+        output list (batch axis stripped).  Raises ``QueueFullError`` when
+        admission control rejects, ``ServerClosedError`` after ``stop``;
+        the future raises ``DeadlineExceededError`` if ``deadline_ms``
+        elapses while the request is still queued."""
+        missing = set(self._item_shapes) - set(inputs)
+        if missing:
+            raise MXNetError("missing inputs %s" % sorted(missing))
+        coerced = {k: self._coerce(k, v) for k, v in inputs.items()}
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        future = Future()
+        self._batcher.put(coerced, future, deadline)
+        return future
+
+    def predict(self, deadline_ms: Optional[float] = None,
+                **inputs) -> List[np.ndarray]:
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(deadline_ms=deadline_ms, **inputs).result()
+
+    def queue_depth(self):
+        return self._batcher.queue_depth()
+
+    def metrics_text(self):
+        return self.metrics.render_text()
+
+    # -- HTTP front end ---------------------------------------------------
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the stdlib HTTP endpoint in a daemon thread; returns the
+        bound ``(host, port)``.
+
+        * ``POST /predict`` — body ``{"inputs": {name: nested list},
+          "deadline_ms": optional}`` → ``{"outputs": [...]}``; 503 when
+          the queue is full (retry with backoff), 504 past deadline.
+        * ``GET /metrics`` — Prometheus text.
+        * ``GET /healthz`` — liveness.
+        """
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # keep pytest/console output clean
+                pass
+
+            def _reply(self, code, body, ctype="application/json"):
+                data = body if isinstance(body, bytes) else body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    self._reply(200, server.metrics_text(),
+                                ctype="text/plain; version=0.0.4")
+                elif self.path == "/healthz":
+                    self._reply(200, "ok", ctype="text/plain")
+                else:
+                    self._reply(404, json.dumps({"error": "not found"}))
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, json.dumps({"error": "not found"}))
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    fut = server.submit(deadline_ms=req.get("deadline_ms"),
+                                        **req.get("inputs", {}))
+                    outs = fut.result()
+                    self._reply(200, json.dumps(
+                        {"outputs": [np.asarray(o).tolist() for o in outs]}))
+                except QueueFullError as exc:
+                    self._reply(503, json.dumps({"error": str(exc)}))
+                except DeadlineExceededError as exc:
+                    self._reply(504, json.dumps({"error": str(exc)}))
+                except ServerClosedError as exc:
+                    self._reply(503, json.dumps({"error": str(exc)}))
+                except (MXNetError, ValueError, TypeError,
+                        json.JSONDecodeError) as exc:
+                    self._reply(400, json.dumps({"error": str(exc)}))
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="mxtpu-serving-http",
+            daemon=True)
+        self._http_thread.start()
+        return self._httpd.server_address

@@ -61,6 +61,10 @@ def test_convert_and_forward_matches_manual_model():
     sym, args, aux = caffe.convert_model(LENET_ISH, model)
     assert set(args) == {"conv1_weight", "conv1_bias",
                         "ip1_weight", "ip1_bias"}
+    # the first conv consumes 3-channel input: the converter applies the
+    # reference's BGR->RGB channel swap (convert_model.py:68-71)
+    np.testing.assert_array_equal(args["conv1_weight"].asnumpy(),
+                                  W[:, [2, 1, 0]])
     x = rng.randn(2, 3, 8, 8).astype("f")
     ex = sym.simple_bind(mx.cpu(), data=(2, 3, 8, 8), grad_req="null")
     for k, v in args.items():
@@ -78,12 +82,59 @@ def test_convert_and_forward_matches_manual_model():
                                 num_hidden=5)
     net = mx.sym.SoftmaxOutput(net, name="prob")
     ex2 = net.simple_bind(mx.cpu(), data=(2, 3, 8, 8), grad_req="null")
-    ex2.arg_dict["c_weight"][:] = W
+    ex2.arg_dict["c_weight"][:] = W[:, [2, 1, 0]]  # converter swapped BGR
     ex2.arg_dict["c_bias"][:] = b
     ex2.arg_dict["f_weight"][:] = Wf
     ex2.arg_dict["f_bias"][:] = bf
     want = ex2.forward(is_train=False, data=x)[0].asnumpy()
     np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_bgr_swap_first_conv_only():
+    """Only the FIRST convolution (the one seeing 3/4-channel image
+    input) gets the BGR->RGB swap; deeper convs keep their layout, and
+    1-channel first convs are untouched."""
+    proto = """
+input: "data"
+input_dim: 1
+input_dim: 3
+input_dim: 6
+input_dim: 6
+layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+layer { name: "c2" type: "Convolution" bottom: "c1" top: "c2"
+  convolution_param { num_output: 2 kernel_size: 3 pad: 1 } }
+"""
+    rng = np.random.RandomState(7)
+    W1 = rng.randn(4, 3, 3, 3).astype("f")
+    W2 = rng.randn(2, 4, 3, 3).astype("f")
+    model = caffe.encode_caffemodel({"c1": [W1], "c2": [W2]})
+    _, args, _ = caffe.convert_model(proto, model)
+    np.testing.assert_array_equal(args["c1_weight"].asnumpy(),
+                                  W1[:, [2, 1, 0]])
+    np.testing.assert_array_equal(args["c2_weight"].asnumpy(), W2)
+
+    gray = proto.replace("input_dim: 3", "input_dim: 1")
+    Wg = rng.randn(4, 1, 3, 3).astype("f")
+    model = caffe.encode_caffemodel(
+        {"c1": [Wg], "c2": [W2]})
+    _, args, _ = caffe.convert_model(gray, model)
+    np.testing.assert_array_equal(args["c1_weight"].asnumpy(), Wg)
+
+
+def test_blobs_absent_from_prototxt_are_skipped():
+    """Train-vs-deploy mismatch: caffemodel blobs whose layer is not in
+    the deploy prototxt must not leak stray params into arg_params."""
+    rng = np.random.RandomState(8)
+    model = caffe.encode_caffemodel({
+        "conv1": [rng.randn(4, 3, 3, 3).astype("f"),
+                  rng.randn(4).astype("f")],
+        "ip1": [rng.randn(5, 4 * 4 * 4).astype("f")],
+        "loss_only_fc": [rng.randn(2, 5).astype("f"),
+                         rng.randn(2).astype("f")]})
+    _, args, aux = caffe.convert_model(LENET_ISH, model)
+    assert set(args) == {"conv1_weight", "conv1_bias", "ip1_weight"}
+    assert not aux
 
 
 def test_batchnorm_scale_merging():

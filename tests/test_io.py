@@ -173,6 +173,36 @@ def test_indexed_recordio():
         r.close()
 
 
+def test_indexed_recordio_missing_idx_closes_rec_handle():
+    """When the sidecar .idx fails to open, the already-open .rec handle
+    must be closed (ImageIter's remote-URI fallback constructs one of
+    these per miss — it must not leak a handle each time)."""
+    from mxnet_tpu import filesystem
+
+    opened = []
+    orig = filesystem.open_uri
+
+    def tracking_open(uri, mode):
+        h = orig(uri, mode)
+        opened.append(h)
+        return h
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "test.rec")
+        w = recordio.MXRecordIO(path, "w")
+        w.write(b"payload")
+        w.close()
+        filesystem.open_uri = tracking_open
+        try:
+            with pytest.raises(Exception):
+                recordio.MXIndexedRecordIO(
+                    os.path.join(d, "missing.idx"), path, "r")
+        finally:
+            filesystem.open_uri = orig
+        assert len(opened) == 1  # the .rec opened, the .idx never did
+        assert opened[0].closed
+
+
 def test_irheader_pack_unpack():
     header = recordio.IRHeader(0, 2.0, 7, 0)
     data = b"imagebytes"

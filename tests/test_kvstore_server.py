@@ -16,6 +16,40 @@ from mxnet_tpu import kvstore_server as kvs
 from mxnet_tpu.test_utils import assert_almost_equal
 
 
+def test_wire_noncontiguous_array_falls_back_inband():
+    """A non-contiguous ndarray (transposed/sliced view) cannot expose a
+    flat pickle-5 buffer; the wire must fall back to in-band pickling
+    instead of dying with BufferError mid-send."""
+    import socket
+
+    a, b = socket.socketpair()
+    try:
+        arr = np.arange(24, dtype=np.float32).reshape(4, 6).T  # not C-contig
+        assert not arr.flags.c_contiguous
+        kvs._send_msg(a, {"cmd": "push", "value": arr})
+        msg = kvs._recv_msg(b)
+        np.testing.assert_array_equal(msg["value"], arr)
+        # contiguous arrays still take the zero-copy out-of-band path
+        kvs._send_msg(a, np.ones(8, np.float32))
+        np.testing.assert_array_equal(kvs._recv_msg(b), np.ones(8))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_version_mismatch_is_a_clear_error():
+    import socket
+
+    a, b = socket.socketpair()
+    try:
+        a.sendall(bytes([kvs._WIRE_VERSION + 1]) + b"\x00" * kvs._HDR.size)
+        with pytest.raises(ConnectionError, match="wire version mismatch"):
+            kvs._recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
 def test_server_async_accumulate():
     """No updater installed: pushes accumulate into the store."""
     srv = kvs.start_server(num_workers=2)

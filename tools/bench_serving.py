@@ -1,0 +1,143 @@
+"""Offered-load sweep for mx.serving.InferenceServer.
+
+For each offered QPS, open-loop submitters fire single-item requests at
+exponential inter-arrival times for --duration seconds, then one JSON
+line per load point reports achieved QPS, latency quantiles, mean batch
+occupancy, and the reject/expire rates — the capacity-planning companion
+to tools/perf_probe.py (same style: stdlib-only CLI, JSON out).
+
+Usage:
+  python tools/bench_serving.py [--load 50,200,800] [--duration 3]
+                                [--max-batch 32] [--max-wait-us 2000]
+                                [--hidden 256] [--in-dim 512]
+                                [--replicas 1] [--out bench_serving.jsonl]
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_server(cli):
+    import numpy as np
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(0)
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=cli.hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=cli.hidden, name="fc2")
+    params = {
+        "fc1_weight": mx.nd.array(
+            rng.randn(cli.hidden, cli.in_dim).astype(np.float32) * 0.05),
+        "fc1_bias": mx.nd.array(np.zeros(cli.hidden, np.float32)),
+        "fc2_weight": mx.nd.array(
+            rng.randn(cli.hidden, cli.hidden).astype(np.float32) * 0.05),
+        "fc2_bias": mx.nd.array(np.zeros(cli.hidden, np.float32)),
+    }
+    ctx = ([mx.current_context()] if cli.replicas == 1
+           else [mx.cpu(i) for i in range(cli.replicas)])
+    return mx.serving.InferenceServer(
+        net, params, {"data": (cli.max_batch, cli.in_dim)}, ctx=ctx,
+        max_wait_us=cli.max_wait_us, max_queue=cli.max_queue)
+
+
+def run_load_point(srv, offered_qps, duration, in_dim, n_threads=8):
+    import numpy as np
+    from mxnet_tpu import serving
+
+    x = np.zeros(in_dim, np.float32)
+    stop_at = time.monotonic() + duration
+    counts = {"submitted": 0, "rejected": 0, "expired": 0}
+    lock = threading.Lock()
+    futures = []
+    per_thread_qps = offered_qps / n_threads
+
+    def submitter(seed):
+        rng = random.Random(seed)
+        while time.monotonic() < stop_at:
+            time.sleep(rng.expovariate(per_thread_qps))
+            try:
+                fut = srv.submit(data=x)
+                with lock:
+                    counts["submitted"] += 1
+                    futures.append(fut)
+            except serving.QueueFullError:
+                with lock:
+                    counts["rejected"] += 1
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=submitter, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for fut in futures:
+        try:
+            fut.result(timeout=60)
+        except serving.DeadlineExceededError:
+            counts["expired"] += 1
+    elapsed = time.monotonic() - t0
+    snap = srv.metrics.snapshot()
+    occ = snap["occupancy_hist"]
+    total_items = sum(n * c for n, c in occ.items())
+    return {
+        "offered_qps": offered_qps,
+        "achieved_qps": counts["submitted"] / elapsed,
+        "submitted": counts["submitted"],
+        "rejected": counts["rejected"],
+        "expired": counts["expired"],
+        "latency_ms_p50": snap["latency_ms_p50"],
+        "latency_ms_p99": snap["latency_ms_p99"],
+        "batches": snap["batches_total"],
+        "mean_batch_occupancy": (total_items / snap["batches_total"]
+                                 if snap["batches_total"] else 0.0),
+        "padded_items": snap["padded_items_total"],
+        "queue_depth_peak": snap["queue_depth_peak"],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--load", default="50,200,800",
+                    help="comma-separated offered QPS points")
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--max-wait-us", type=int, default=2000)
+    ap.add_argument("--max-queue", type=int, default=1024)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--in-dim", type=int, default=512)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--out", default=None,
+                    help="also append JSON lines to this file")
+    cli = ap.parse_args()
+
+    loads = [float(s) for s in cli.load.split(",") if s]
+    sink = open(cli.out, "a") if cli.out else None
+    for qps in loads:
+        # fresh server per point so histograms/latency don't bleed across
+        srv = build_server(cli)
+        try:
+            row = run_load_point(srv, qps, cli.duration, cli.in_dim)
+        finally:
+            srv.stop()
+        row["max_batch"] = cli.max_batch
+        row["max_wait_us"] = cli.max_wait_us
+        row["replicas"] = cli.replicas
+        line = json.dumps(row)
+        print(line, flush=True)
+        if sink:
+            sink.write(line + "\n")
+            sink.flush()
+    if sink:
+        sink.close()
+
+
+if __name__ == "__main__":
+    main()

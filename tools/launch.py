@@ -183,6 +183,16 @@ def main():
                 p.wait()
                 rc = rc or p.returncode
     finally:
+        # grace period before the TERM sweep: servers that exit on their
+        # own (cooperative-stop command, or short-lived stub programs in
+        # tests) must not race the teardown — without this a server
+        # process spawned moments ago can be killed before it ever runs
+        import time
+
+        deadline = time.monotonic() + 1.0
+        for p in server_procs:
+            while p.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.02)
         for p in procs + server_procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
