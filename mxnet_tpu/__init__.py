@@ -69,6 +69,7 @@ from . import optimizer
 from . import lr_scheduler
 from . import metric
 from . import callback
+from . import faults
 from . import kvstore
 from . import kvstore as kv
 # server-role bootstrap: under DMLC_ROLE=server this serves and exits

@@ -24,6 +24,17 @@ from .base import MXNetError
 
 __all__ = ["save_sharded_checkpoint", "load_sharded_checkpoint"]
 
+# written (by process 0) only after every process's shards have landed; a
+# directory without it is a crash-torn save.  Orbax's own commit marker
+# (commit_success.txt) is honored too, for checkpoints written before this
+# guard existed.
+_COMPLETE_MARKER = "mxnet_complete"
+
+
+def _is_complete(path):
+    return (os.path.exists(os.path.join(path, _COMPLETE_MARKER))
+            or os.path.exists(os.path.join(path, "commit_success.txt")))
+
 
 def _to_tree(arg_params, aux_params):
     from . import ndarray as nd
@@ -50,6 +61,11 @@ def save_sharded_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     tree = _to_tree(arg_params, aux_params)
     ckpt = ocp.PyTreeCheckpointer()
     ckpt.save(path, tree, force=True)
+    if jax.process_index() == 0:
+        from .filesystem import atomic_write
+
+        atomic_write(os.path.join(path, _COMPLETE_MARKER),
+                     lambda f: f.write(b"ok\n"), op="ckpt.write")
     return path
 
 
@@ -67,6 +83,11 @@ def load_sharded_checkpoint(prefix, epoch, shardings=None):
     path = os.path.abspath("%s-%04d.orbax" % (prefix, epoch))
     if not os.path.isdir(path):
         raise MXNetError("no sharded checkpoint at %s" % path)
+    if not _is_complete(path):
+        raise MXNetError(
+            "sharded checkpoint %s is incomplete (no completion marker): "
+            "the saving job likely crashed mid-write — fall back to an "
+            "earlier epoch" % path)
     ckpt = ocp.PyTreeCheckpointer()
     if shardings is not None:
         # pass shardings INTO orbax so each process reads only the shards

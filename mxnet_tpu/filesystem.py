@@ -22,7 +22,9 @@ resolve through ``open_uri``.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict
+import os
+import zlib
+from typing import Callable, Dict, Optional
 
 _SCHEMES: Dict[str, Callable] = {}
 
@@ -90,3 +92,115 @@ def open_uri(uri: str, mode: str = "rb"):
             "lambda uri, mode: fsspec.open(uri, mode).open())%s"
             % (scheme, uri, scheme, hint))
     return opener(uri, mode)
+
+
+# ---------------------------------------------------------------------------
+# Durable local writes — the crash-consistency primitives the checkpoint
+# and kvstore-snapshot writers sit on (docs/how_to/fault_tolerance.md).
+# The reference writes .params with a bare fopen/fwrite
+# (ndarray.cc:633-714): a crash mid-save leaves a torn file that LOOKS
+# like the newest checkpoint.  Here every durable artifact goes through
+# tmp + fsync + os.replace (readers only ever see old-complete or
+# new-complete bytes) and carries a CRC32 sidecar so silent corruption
+# (torn writes from OTHER writers, bit rot, partial copies) is detected
+# at discovery time instead of mid-restore.
+# ---------------------------------------------------------------------------
+
+_CRC_SUFFIX = ".crc32"
+_CRC_CHUNK = 1 << 20
+
+
+def file_crc32(path: str) -> int:
+    """Streaming CRC32 of a file's bytes (constant memory)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_CRC_CHUNK)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
+
+
+def crc_sidecar_path(path: str) -> str:
+    return path + _CRC_SUFFIX
+
+
+def write_crc_sidecar(path: str) -> str:
+    """Record ``crc32 size`` of ``path`` in an (atomically written)
+    sidecar; returns the sidecar path."""
+    line = "%08x %d\n" % (file_crc32(path), os.path.getsize(path))
+    side = crc_sidecar_path(path)
+    atomic_write(side, lambda f: f.write(line.encode("ascii")),
+                 checksum=False, op="crc.sidecar")
+    return side
+
+
+def verify_crc_sidecar(path: str) -> Optional[bool]:
+    """True/False when a sidecar exists and the file matches/mismatches;
+    None when there is no sidecar to judge by (pre-sidecar artifact)."""
+    side = crc_sidecar_path(path)
+    if not os.path.exists(side):
+        return None
+    try:
+        with open(side, "r") as f:
+            crc_s, size_s = f.read().split()
+        if not os.path.exists(path):
+            return False
+        if os.path.getsize(path) != int(size_s):
+            return False
+        return file_crc32(path) == int(crc_s, 16)
+    except (OSError, ValueError):
+        return False
+
+
+def atomic_write(path: str, writer: Callable, checksum: bool = False,
+                 op: str = "file.write") -> str:
+    """Crash-safe replace of ``path``: ``writer(f)`` fills a same-dir temp
+    file, which is fsync'd and ``os.replace``'d over the target — readers
+    never observe a partial file.  With ``checksum`` a CRC32 sidecar is
+    written after the data lands.  ``op`` names this site to the fault
+    layer: an active plan's ``partial`` rule tears the TEMP file and
+    raises (simulating power loss mid-write) — the target is untouched,
+    which is exactly the guarantee under test.
+    """
+    from . import faults
+
+    faults.fire(op)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    try:
+        with open(tmp, "wb") as f:
+            writer(f)
+            frac = faults.partial_fraction(op)
+            if frac is not None:
+                # torn write: keep a prefix, make it durable, then die the
+                # way a crashed writer would (before the replace)
+                f.flush()
+                f.truncate(max(0, int(f.tell() * frac)))
+                f.flush()
+                os.fsync(f.fileno())
+                raise faults.InjectedIOError(
+                    "injected torn write at %s (%s)" % (op, path))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        # fsync the directory so the rename itself survives power loss
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # platform without dir fsync: best effort
+    except faults.InjectedIOError:
+        raise  # leave the torn temp behind, as a real crash would
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if checksum:
+        write_crc_sidecar(path)
+    return path

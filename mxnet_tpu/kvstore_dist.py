@@ -203,17 +203,25 @@ class DistSyncKVStore(KVStore):
         seq = [0]
 
         def beat_once():
+            from . import faults
+
             # publish a SEQUENCE NUMBER, not a wall-clock timestamp: hosts'
             # clocks skew, but a stale-vs-advancing counter is judged
             # entirely against the READER's monotonic clock
+            faults.fire("kv.dist.heartbeat")
             seq[0] += 1
             client.key_value_set("mxtpu_hb/%d" % rank, str(seq[0]),
                                  allow_overwrite=True)
 
         def loop(stop):
+            from . import faults
+
             while not stop.wait(interval):
                 try:
                     beat_once()
+                except (faults.InjectedConnectionError,
+                        faults.InjectedIOError):
+                    continue  # injected transient: miss this beat, go stale
                 except Exception:
                     return
 

@@ -24,13 +24,16 @@ exits when a stop command arrives.
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
+import random
 import socket
 import socketserver
 import struct
 import threading
 import time
+import uuid
 from typing import Dict, Optional
 
 import numpy as np
@@ -39,11 +42,53 @@ import numpy as np
 # must NOT run `from . import ...`: under the DMLC_ROLE=server bootstrap the
 # main thread is still inside the package import and holds the import lock,
 # so a handler-side relative import deadlocks the whole server
+from . import faults
 from . import ndarray as nd
 from . import optimizer as opt
+from .base import register_env
 
 __all__ = ["KVStoreServer", "start_server", "ServerClient",
            "_init_kvstore_server_module"]
+
+register_env("MXNET_KVSTORE_RETRY_MAX", 10, int,
+             "Max reconnect/replay attempts per kvstore client RPC.")
+register_env("MXNET_KVSTORE_RETRY_INITIAL_MS", 50, float,
+             "First retry backoff in ms (doubles per attempt).")
+register_env("MXNET_KVSTORE_RETRY_MAX_MS", 2000, float,
+             "Backoff ceiling in ms.")
+register_env("MXNET_KVSTORE_RETRY_JITTER", 0.2, float,
+             "Multiplicative backoff jitter fraction (decorrelates a "
+             "worker fleet hammering a restarting server).")
+register_env("MXNET_KVSTORE_SNAPSHOT_PATH", "", str,
+             "Durable snapshot file for the kvstore server; empty "
+             "disables journaling.")
+register_env("MXNET_KVSTORE_SNAPSHOT_INTERVAL", 30, float,
+             "Seconds between periodic server snapshots; <= 0 snapshots "
+             "only on demand and clean stop.")
+
+
+# -- retry/backoff knobs (docs/how_to/fault_tolerance.md) -------------------
+# A worker-side RPC that hits a dead connection reconnects with exponential
+# backoff + jitter and REPLAYS the request under the same idempotency token;
+# the server deduplicates, so a push whose ACK was lost is applied exactly
+# once (the reference's ps-lite resender, ps/internal/van.h, solved the same
+# dropped-ACK double-apply).
+def _retry_conf():
+    return {
+        "retries": int(os.environ.get("MXNET_KVSTORE_RETRY_MAX", "10")),
+        "initial": float(os.environ.get("MXNET_KVSTORE_RETRY_INITIAL_MS",
+                                        "50")) / 1e3,
+        "cap": float(os.environ.get("MXNET_KVSTORE_RETRY_MAX_MS",
+                                    "2000")) / 1e3,
+        "jitter": float(os.environ.get("MXNET_KVSTORE_RETRY_JITTER", "0.2")),
+    }
+
+
+def _backoff_sleep(attempt, conf):
+    """Exponential backoff with multiplicative jitter (decorrelates a
+    worker fleet hammering a restarting server)."""
+    base = min(conf["cap"], conf["initial"] * (2 ** attempt))
+    time.sleep(base * (1.0 + conf["jitter"] * random.random()))
 
 # wire: 1 version byte, <payload_len, n_bufs> header, n_bufs buffer
 # lengths, pickled metadata, then the raw array buffers OUT OF BAND
@@ -58,7 +103,9 @@ _HDR = struct.Struct("<QI")
 _LEN = struct.Struct("<Q")
 
 
-def _send_msg(sock, obj):
+def _send_msg(sock, obj, op=None):
+    if op is not None:
+        faults.fire(op)
     bufs = []
     payload = pickle.dumps(obj, protocol=5, buffer_callback=bufs.append)
     try:
@@ -88,7 +135,9 @@ def _recv_exact(sock, n):
     return buf
 
 
-def _recv_msg(sock):
+def _recv_msg(sock, op=None):
+    if op is not None:
+        faults.fire(op)
     ver = _recv_exact(sock, 1)[0]
     if ver != _WIRE_VERSION:
         raise ConnectionError(
@@ -109,10 +158,25 @@ def _recv_msg(sock):
 class KVStoreServer:
     """Async parameter server: per-key store + updater applied on every
     push (async mode, kvstore_dist_server.h:198-206) or after all workers'
-    pushes merge (sync mode, :164-179)."""
+    pushes merge (sync mode, :164-179).
+
+    Crash tolerance (docs/how_to/fault_tolerance.md):
+
+    * requests may arrive wrapped in an idempotency envelope
+      ``("req", client_id, seq, inner)``; the server records the last
+      applied (seq, reply) per client and REPLAYS the recorded reply for a
+      retried seq instead of re-dispatching — a push whose ACK was lost on
+      the wire is applied exactly once.
+    * with ``snapshot_path`` set (or ``MXNET_KVSTORE_SNAPSHOT_PATH``), the
+      full server state — store, updater (with live momentum), barrier
+      generation, sync-merge rounds, dedup records — is journaled to an
+      atomic CRC-checked snapshot every ``snapshot_interval`` seconds, on
+      clean stop, and on the ``snapshot`` command; a restarted server
+      restores it and re-admits reconnecting workers mid-barrier.
+    """
 
     def __init__(self, host="127.0.0.1", port=0, num_workers=1,
-                 sync_mode=False):
+                 sync_mode=False, snapshot_path=None, snapshot_interval=None):
         self.num_workers = num_workers
         self.sync_mode = sync_mode
         self.store: Dict[object, np.ndarray] = {}
@@ -127,19 +191,36 @@ class KVStoreServer:
         # ps::Postoffice node tracking behind GetDeadNodes,
         # kvstore_dist.h:151-160)
         self._heartbeats: Dict[int, float] = {}
+        # idempotency records: client_id -> {"seq", "done", "reply"} for
+        # that client's newest request.  Clients issue requests serially
+        # (one in flight, strictly increasing seq), so one record per
+        # client is complete dedup state.
+        self._dedup: Dict[str, dict] = {}
+        self._dedup_cv = threading.Condition()
+        self.applied_pushes = 0  # distinct (non-replayed) push applications
+        self.restored = False
+        self.snapshot_path = snapshot_path if snapshot_path is not None \
+            else (os.environ.get("MXNET_KVSTORE_SNAPSHOT_PATH") or None)
+        self._snap_interval = float(
+            snapshot_interval if snapshot_interval is not None
+            else os.environ.get("MXNET_KVSTORE_SNAPSHOT_INTERVAL", "30"))
+        if self.snapshot_path:
+            self.restored = self._restore_snapshot()
         server_self = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
                     while True:
-                        msg = _recv_msg(self.request)
-                        try:
-                            reply = server_self._dispatch(msg)
-                        except Exception as e:  # keep serving; tell the client
-                            reply = ("err", "%s: %s" % (type(e).__name__, e))
-                        _send_msg(self.request, reply)
-                        if msg[0] == "stop":
+                        msg = _recv_msg(self.request, op="kv.server.recv")
+                        if isinstance(msg, tuple) and msg and \
+                                msg[0] == "req":
+                            _, cid, seq, inner = msg
+                        else:
+                            cid, seq, inner = None, None, msg
+                        reply = server_self._serve_one(cid, seq, inner)
+                        _send_msg(self.request, reply, op="kv.server.send")
+                        if inner[0] == "stop":
                             break
                 except (ConnectionError, OSError):
                     pass
@@ -150,6 +231,45 @@ class KVStoreServer:
 
         self._server = Server((host, port), Handler)
         self.addr = self._server.server_address
+        self._snap_thread = None
+        if self.snapshot_path and self._snap_interval > 0:
+            self._snap_thread = threading.Thread(
+                target=self._snapshot_loop, name="kvsrv-snapshot",
+                daemon=True)
+            self._snap_thread.start()
+
+    # -- idempotent request admission --------------------------------------
+    def _serve_one(self, cid, seq, msg):
+        """Dispatch one request, deduplicating retries by (cid, seq).  A
+        replayed token returns the recorded reply (waiting out a still-
+        running original, e.g. a barrier whose connection died while
+        parked) without re-running the command."""
+        if cid is None:
+            return self._dispatch_safe(msg)
+        with self._dedup_cv:
+            ent = self._dedup.get(cid)
+            if ent is not None and seq == ent["seq"]:
+                while not ent["done"]:
+                    self._dedup_cv.wait(0.1)
+                return ent["reply"]
+            if ent is not None and seq < ent["seq"]:
+                return ("err", "stale request token %s < %s (client %s)"
+                        % (seq, ent["seq"], cid))
+            ent = {"seq": seq, "done": False, "reply": None}
+            self._dedup[cid] = ent
+        reply = self._dispatch_safe(msg)
+        with self._dedup_cv:
+            if self._dedup.get(cid) is ent:
+                ent["reply"] = reply
+                ent["done"] = True
+                self._dedup_cv.notify_all()
+        return reply
+
+    def _dispatch_safe(self, msg):
+        try:
+            return self._dispatch(msg)
+        except Exception as e:  # keep serving; tell the client
+            return ("err", "%s: %s" % (type(e).__name__, e))
 
     # -- message dispatch --------------------------------------------------
     def _dispatch(self, msg):
@@ -163,6 +283,7 @@ class KVStoreServer:
             key, arr = msg[1], msg[2]
             rank = msg[3] if len(msg) > 3 else 0
             with self._lock:
+                self.applied_pushes += 1
                 if self.sync_mode:
                     # per-worker rounds: a fast worker's next-iteration push
                     # must not count toward the current round
@@ -257,8 +378,19 @@ class KVStoreServer:
                             self._barrier_ranks.discard(rank)
                         return ("err",
                                 "barrier timed out after %.0fs" % timeout)
+        if cmd == "snapshot":
+            # force a durable snapshot NOW (workers quiesce at a barrier,
+            # rank 0 snapshots, and the job is then kill-safe to that point)
+            path = self.snapshot()
+            if path is None:
+                return ("err", "server has no snapshot_path configured")
+            return ("ok", path)
         if cmd == "stop":
             self._stop.set()
+            try:
+                self.snapshot()
+            except Exception as e:
+                logging.warning("kvstore snapshot on stop failed: %s", e)
             threading.Thread(target=self._server.shutdown,
                              daemon=True).start()
             return ("ok",)
@@ -286,6 +418,94 @@ class KVStoreServer:
         self.updater(key, nd.array(grad), weight)
         self.store[key] = weight.asnumpy()
 
+    # -- durable snapshots --------------------------------------------------
+    _SNAP_VERSION = 1
+
+    def snapshot(self):
+        """Write the full server state to ``snapshot_path`` atomically
+        (tmp + fsync + replace, CRC32 sidecar).  Returns the path, or None
+        when no snapshot path is configured.  State captured: the store,
+        the updater (optimizer + live momentum), barrier generation,
+        pending sync-merge rounds, and idempotency records — everything a
+        restarted server needs to re-admit its workers."""
+        if not self.snapshot_path:
+            return None
+        from .filesystem import atomic_write
+
+        with self._lock:
+            store = dict(self.store)
+            merge = {k: [dict(rnd) for rnd in rounds]
+                     for k, rounds in self._merge.items()}
+            updater_bytes = (pickle.dumps(self.updater,
+                                          pickle.HIGHEST_PROTOCOL)
+                            if self.updater is not None else None)
+            applied = self.applied_pushes
+        with self._dedup_cv:
+            dedup = {cid: {"seq": e["seq"], "done": True,
+                           "reply": e["reply"]}
+                     for cid, e in self._dedup.items() if e["done"]}
+        state = {
+            "version": self._SNAP_VERSION,
+            "store": store,
+            "merge": merge,
+            "updater": updater_bytes,
+            "barrier_gen": self._barrier_gen,
+            "dedup": dedup,
+            "applied_pushes": applied,
+            "num_workers": self.num_workers,
+            "sync_mode": self.sync_mode,
+        }
+        payload = pickle.dumps(state, pickle.HIGHEST_PROTOCOL)
+        atomic_write(self.snapshot_path, lambda f: f.write(payload),
+                     checksum=True, op="kvsnap.write")
+        return self.snapshot_path
+
+    def _restore_snapshot(self):
+        """Load ``snapshot_path`` if present and intact; a missing, torn,
+        or CRC-mismatched snapshot is skipped (cold start) rather than
+        crashing the restart loop."""
+        from .filesystem import verify_crc_sidecar
+
+        path = self.snapshot_path
+        if not path or not os.path.exists(path):
+            return False
+        if verify_crc_sidecar(path) is False:
+            logging.warning("kvstore snapshot %s fails its CRC sidecar; "
+                            "starting cold", path)
+            return False
+        try:
+            with open(path, "rb") as f:
+                state = pickle.load(f)
+            if state.get("version") != self._SNAP_VERSION:
+                raise ValueError("snapshot version %r"
+                                 % (state.get("version"),))
+            updater = (pickle.loads(state["updater"])
+                       if state.get("updater") is not None else None)
+        except Exception as e:
+            logging.warning("kvstore snapshot %s is unreadable (%s); "
+                            "starting cold", path, e)
+            return False
+        with self._lock:
+            self.store = dict(state.get("store", {}))
+            self._merge = {k: [dict(rnd) for rnd in rounds]
+                           for k, rounds in state.get("merge", {}).items()}
+            self.updater = updater
+            self.applied_pushes = int(state.get("applied_pushes", 0))
+        with self._barrier_cv:
+            self._barrier_gen = int(state.get("barrier_gen", 0))
+        with self._dedup_cv:
+            self._dedup = dict(state.get("dedup", {}))
+        logging.info("kvstore server restored %d keys (barrier gen %d) "
+                     "from %s", len(self.store), self._barrier_gen, path)
+        return True
+
+    def _snapshot_loop(self):
+        while not self._stop.wait(self._snap_interval):
+            try:
+                self.snapshot()
+            except Exception as e:
+                logging.warning("periodic kvstore snapshot failed: %s", e)
+
     # -- lifecycle ---------------------------------------------------------
     def serve_forever(self):
         self._server.serve_forever(poll_interval=0.05)
@@ -297,26 +517,114 @@ class KVStoreServer:
 
     def stop(self):
         self._stop.set()
+        try:
+            self.snapshot()
+        except Exception as e:
+            logging.warning("kvstore snapshot on stop failed: %s", e)
         self._server.shutdown()
         self._server.server_close()
 
 
 class ServerClient:
-    """Worker-side connection to a KVStoreServer (the ps::KVWorker role)."""
+    """Worker-side connection to a KVStoreServer (the ps::KVWorker role).
+
+    Crash-tolerant transport: every RPC carries an idempotency token
+    ``(client_id, seq)``; on any connection failure the client reconnects
+    with exponential backoff + jitter (``MXNET_KVSTORE_RETRY_*``) and
+    replays the SAME token, which the server deduplicates — so a retried
+    ``push`` after a dropped ACK is applied exactly once, and a server
+    kill+restart (snapshot recovery) is ridden out transparently as long
+    as it returns within the retry budget.
+
+    Usable as a context manager; ``close()`` is idempotent and always
+    joins the heartbeat thread.
+    """
 
     def __init__(self, host, port):
         self._addr = (host, port)
-        self._sock = socket.create_connection((host, port), timeout=120)
+        self._cid = uuid.uuid4().hex  # idempotency namespace for this client
+        self._seq = 0
+        self._sock = None
         self._lock = threading.Lock()
+        self._closed = False
         self._hb_stop = None
+        self._hb_thread = None
+        self._connect(_retry_conf())
 
+    # -- transport ---------------------------------------------------------
+    def _connect(self, conf):
+        last = None
+        for attempt in range(conf["retries"] + 1):
+            try:
+                faults.fire("kv.client.connect")
+                self._sock = socket.create_connection(self._addr,
+                                                      timeout=120)
+                return
+            except OSError as e:
+                last = e
+                self._sock = None
+                if attempt >= conf["retries"]:
+                    break
+                _backoff_sleep(attempt, conf)
+        raise ConnectionError(
+            "kvstore server %s:%d unreachable after %d attempts: %s"
+            % (self._addr[0], self._addr[1], conf["retries"] + 1, last))
+
+    def _drop_sock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _request(self, msg, retries=None):
+        """One idempotent round trip: send ``("req", cid, seq, msg)``,
+        reconnect+replay on connection failure.  Caller holds _lock."""
+        conf = _retry_conf()
+        if retries is not None:
+            conf = dict(conf, retries=retries)
+        self._seq += 1
+        envelope = ("req", self._cid, self._seq, msg)
+        last = None
+        for attempt in range(conf["retries"] + 1):
+            try:
+                if self._sock is None:
+                    self._connect(conf)
+                _send_msg(self._sock, envelope, op="kv.client.send")
+                return _recv_msg(self._sock, op="kv.client.recv")
+            except (ConnectionError, OSError, EOFError) as e:
+                last = e
+                self._drop_sock()
+                if attempt >= conf["retries"]:
+                    break
+                _backoff_sleep(attempt, conf)
+        raise ConnectionError(
+            "kvstore rpc %r to %s:%d failed after %d attempts: %s"
+            % (msg[0], self._addr[0], self._addr[1],
+               conf["retries"] + 1, last))
+
+    def _rpc(self, *msg, **kw):
+        if self._closed:
+            raise ConnectionError("ServerClient is closed")
+        with self._lock:
+            reply = self._request(msg, retries=kw.get("retries"))
+        if reply[0] != "ok":
+            from .base import MXNetError
+
+            raise MXNetError("kvstore server error: %s" % (reply[1],))
+        return reply[1] if len(reply) > 1 else None
+
+    # -- liveness ----------------------------------------------------------
     def start_heartbeat(self, rank, interval=5.0):
         """Publish liveness for ``rank`` every ``interval`` seconds on a
         daemon thread (ps-lite node heartbeats; feeds the server's
         dead-node tracking).  Uses its OWN connection: the main RPC socket
         can sit inside a long blocking barrier() round trip, and a worker
         waiting at a barrier must not go heartbeat-silent (that would make
-        the dead-peer barrier release see live stragglers as dead)."""
+        the dead-peer barrier release see live stragglers as dead).  The
+        loop reconnects after failures, so heartbeats resume on their own
+        once a killed server restarts from its snapshot."""
         if self._hb_stop is not None:
             return
         self._hb_stop = threading.Event()
@@ -325,25 +633,33 @@ class ServerClient:
         self.heartbeat(rank)  # immediate first beat on the main socket
 
         def loop():
-            try:
-                sock = socket.create_connection(addr, timeout=30)
-            except OSError:
-                return
-            try:
-                while not stop.wait(interval):
+            sock = None
+            while not stop.wait(interval):
+                try:
+                    if sock is None:
+                        sock = socket.create_connection(addr, timeout=30)
                     _send_msg(sock, ("heartbeat", rank))
                     reply = _recv_msg(sock)
                     if reply[0] != "ok":
                         return
-            except Exception:
-                return  # connection gone: the server will see us dead
-            finally:
+                except Exception:
+                    # connection gone: drop it and retry next tick — a
+                    # restarting server must see us come back alive
+                    if sock is not None:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        sock = None
+            if sock is not None:
                 try:
                     sock.close()
                 except OSError:
                     pass
 
-        threading.Thread(target=loop, daemon=True).start()
+        self._hb_thread = threading.Thread(target=loop, daemon=True,
+                                           name="kvclient-heartbeat")
+        self._hb_thread.start()
 
     def heartbeat(self, rank):
         self._rpc("heartbeat", rank)
@@ -351,16 +667,7 @@ class ServerClient:
     def dead_nodes(self, timeout_s=60.0):
         return self._rpc("dead_nodes", timeout_s)
 
-    def _rpc(self, *msg):
-        with self._lock:
-            _send_msg(self._sock, msg)
-            reply = _recv_msg(self._sock)
-        if reply[0] != "ok":
-            from .base import MXNetError
-
-            raise MXNetError("kvstore server error: %s" % (reply[1],))
-        return reply[1] if len(reply) > 1 else None
-
+    # -- RPC surface -------------------------------------------------------
     def init(self, key, arr):
         self._rpc("init", key, np.asarray(arr))
 
@@ -378,16 +685,43 @@ class ServerClient:
     def barrier(self, rank=0, is_recovery=False):
         self._rpc("barrier", rank, int(is_recovery))
 
+    def snapshot(self):
+        """Force a durable server snapshot now; returns its path."""
+        return self._rpc("snapshot")
+
     def stop_server(self):
-        self._rpc("stop")
+        # a single retry only: once the server acks and exits, replaying
+        # into a dead address would just burn the whole backoff budget
+        self._rpc("stop", retries=1)
 
+    # -- lifecycle ---------------------------------------------------------
     def close(self):
-        self._sock.close()
+        """Idempotent teardown: stop + join the heartbeat thread, close
+        the RPC socket.  Safe to call any number of times."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+        with self._lock:
+            self._drop_sock()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
-def start_server(host="127.0.0.1", port=0, num_workers=1, sync_mode=False):
+def start_server(host="127.0.0.1", port=0, num_workers=1, sync_mode=False,
+                 snapshot_path=None, snapshot_interval=None):
     """Start a server in this process (background thread); returns it."""
-    srv = KVStoreServer(host, port, num_workers, sync_mode)
+    srv = KVStoreServer(host, port, num_workers, sync_mode,
+                        snapshot_path=snapshot_path,
+                        snapshot_interval=snapshot_interval)
     srv.start_background()
     return srv
 
@@ -414,7 +748,13 @@ def _init_kvstore_server_module():
         port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")) + server_id
     num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
     sync = os.environ.get("MXNET_KVSTORE_SYNC", "0") == "1"
-    srv = KVStoreServer(host, port, num_workers, sync_mode=sync)
+    # each server of a fleet journals to its own snapshot file — the env
+    # var names the shared prefix, the id keeps them from clobbering
+    snap = os.environ.get("MXNET_KVSTORE_SNAPSHOT_PATH") or None
+    if snap and server_id:
+        snap = "%s.%d" % (snap, server_id)
+    srv = KVStoreServer(host, port, num_workers, sync_mode=sync,
+                        snapshot_path=snap)
     srv.serve_forever()
     raise SystemExit(0)
 

@@ -88,14 +88,39 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     """Write prefix-symbol.json + prefix-%04d.params (reference
-    model.py:319-349; format per ndarray.cc:633-714)."""
+    model.py:319-349; format per ndarray.cc:633-714).
+
+    Both files land atomically (tmp + fsync + ``os.replace``) and the
+    params file carries a CRC32 sidecar, so a crash mid-save can neither
+    tear the newest checkpoint nor shadow the previous good one, and
+    :func:`find_latest_checkpoint` can reject corrupted survivors."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    nd.save(param_name, save_dict, checksum=True, op="ckpt.write")
     logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def _checkpoint_ok(path):
+    """Is ``path`` a loadable .params file?  CRC sidecar verdict when one
+    exists; otherwise (pre-sidecar artifact, or a torn temp another writer
+    left behind) a cheap container-magic sniff."""
+    import struct
+
+    from .filesystem import verify_crc_sidecar
+
+    verdict = verify_crc_sidecar(path)
+    if verdict is not None:
+        return verdict
+    try:
+        with open(path, "rb") as f:
+            head = f.read(8)
+        return (len(head) == 8 and
+                struct.unpack("<Q", head)[0] == nd._MAGIC)
+    except OSError:
+        return False
 
 
 def find_latest_checkpoint(prefix):
@@ -104,16 +129,23 @@ def find_latest_checkpoint(prefix):
     The discovery half of checkpoint-based fault tolerance: a relaunched
     worker resumes from here instead of a hand-passed --load-epoch
     (reference mechanism: example/image-classification/common/fit.py
-    --load-epoch; the launcher's --auto-resume mode relies on this)."""
+    --load-epoch; the launcher's --auto-resume mode relies on this).
+    Partial or corrupt files (CRC sidecar mismatch, bad container magic)
+    are skipped, so a crash during save rolls resume back to the newest
+    INTACT epoch instead of wedging every relaunch on a torn file."""
     import glob
     import re
 
     best = None
     for path in glob.glob("%s-[0-9][0-9][0-9][0-9].params" % prefix):
         m = re.search(r"-(\d{4})\.params$", path)
-        if m:
-            ep = int(m.group(1))
-            best = ep if best is None else max(best, ep)
+        if not m:
+            continue
+        if not _checkpoint_ok(path):
+            logging.warning("skipping corrupt checkpoint %s", path)
+            continue
+        ep = int(m.group(1))
+        best = ep if best is None else max(best, ep)
     return best
 
 

@@ -685,8 +685,9 @@ def cpu_ctx():
     return cpu()
 
 
-def save(fname: str, data) -> None:
-    """Save NDArrays in the reference's .params container format."""
+def _save_stream(f, data) -> None:
+    """Write a .params container to any binary file object (the writer
+    half of :func:`_load_stream`)."""
     if isinstance(data, NDArray):
         data = [data]
     names: List[str] = []
@@ -697,16 +698,37 @@ def save(fname: str, data) -> None:
             arrays.append(v)
     else:
         arrays = list(data)
-    with open(fname, "wb") as f:
-        f.write(struct.pack("<QQ", _MAGIC, 0))
-        f.write(struct.pack("<Q", len(arrays)))
-        for arr in arrays:
-            _save_one(f, arr)
-        f.write(struct.pack("<Q", len(names)))
-        for n in names:
-            nb = n.encode("utf-8")
-            f.write(struct.pack("<Q", len(nb)))
-            f.write(nb)
+    f.write(struct.pack("<QQ", _MAGIC, 0))
+    f.write(struct.pack("<Q", len(arrays)))
+    for arr in arrays:
+        _save_one(f, arr)
+    f.write(struct.pack("<Q", len(names)))
+    for n in names:
+        nb = n.encode("utf-8")
+        f.write(struct.pack("<Q", len(nb)))
+        f.write(nb)
+
+
+def save(fname: str, data, checksum: bool = False,
+         op: str = "params.write") -> None:
+    """Save NDArrays in the reference's .params container format.
+
+    Local paths are written atomically (tmp + fsync + ``os.replace``,
+    filesystem.atomic_write): a crash mid-save can no longer leave a torn
+    file that shadows the previous good one.  ``checksum`` additionally
+    writes a CRC32 sidecar (checkpoint saves use this so discovery can
+    reject silently-corrupted files)."""
+    from .filesystem import atomic_write, local_path
+
+    lp = local_path(fname)
+    if lp is not None:
+        atomic_write(lp, lambda f: _save_stream(f, data),
+                     checksum=checksum, op=op)
+        return
+    from .filesystem import open_uri
+
+    with open_uri(fname, "wb") as f:
+        _save_stream(f, data)
 
 
 def _load_stream(f):

@@ -18,12 +18,25 @@ from collections import namedtuple
 import numpy as np
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+           "pack_img", "unpack_img", "RecordIOCorruptError"]
 
 _KMAGIC = 0xced7230a
 _MAGIC_BYTES = struct.pack("<I", _KMAGIC)
 _LFLAG_BITS = 29
 _LENGTH_MASK = (1 << _LFLAG_BITS) - 1
+
+
+class RecordIOCorruptError(IOError):
+    """A RecordIO stream is damaged at ``offset`` (truncated trailing
+    record from an interrupted writer, bad magic, torn multi-part chain).
+    Subclasses IOError, so pre-existing ``except IOError`` handlers keep
+    working; the offset lets tooling truncate-and-salvage the prefix."""
+
+    def __init__(self, message, uri, offset):
+        super().__init__("%s in %s at byte offset %d"
+                         % (message, uri, offset))
+        self.uri = uri
+        self.offset = offset
 
 
 class MXRecordIO:
@@ -113,32 +126,43 @@ class MXRecordIO:
         out = b""
         expect_more = False
         while True:
+            rec_off = self.handle.tell()
             head = self.handle.read(8)
             if len(head) < 8:
                 if expect_more:
-                    raise IOError("truncated multi-part record in %s" % self.uri)
+                    raise RecordIOCorruptError(
+                        "truncated multi-part record", self.uri, rec_off)
+                if head:
+                    # a partial header at EOF is a torn trailing record
+                    # (writer died mid-append), not a clean end-of-stream —
+                    # surface it instead of silently dropping data
+                    raise RecordIOCorruptError(
+                        "truncated trailing record header (%d of 8 bytes)"
+                        % len(head), self.uri, rec_off)
                 return None
             magic, lrec = struct.unpack("<II", head)
             if magic != _KMAGIC:
-                raise IOError("invalid RecordIO magic %#x in %s"
-                              % (magic, self.uri))
+                raise RecordIOCorruptError(
+                    "invalid RecordIO magic %#x" % magic, self.uri, rec_off)
             length = lrec & _LENGTH_MASK
             cflag = lrec >> _LFLAG_BITS
             buf = self.handle.read(length)
             if len(buf) < length:
-                raise IOError("truncated record in %s" % self.uri)
+                raise RecordIOCorruptError(
+                    "truncated record payload (%d of %d bytes)"
+                    % (len(buf), length), self.uri, rec_off)
             pad = (4 - (length % 4)) % 4
             if pad:
                 self.handle.read(pad)
             if cflag in (2, 3):
                 if not expect_more:
-                    raise IOError("unexpected continuation record in %s"
-                                  % self.uri)
+                    raise RecordIOCorruptError(
+                        "unexpected continuation record", self.uri, rec_off)
                 out += _MAGIC_BYTES + buf
             else:
                 if expect_more:
-                    raise IOError("unterminated multi-part record in %s"
-                                  % self.uri)
+                    raise RecordIOCorruptError(
+                        "unterminated multi-part record", self.uri, rec_off)
                 out = buf
             if cflag in (0, 3):
                 return out

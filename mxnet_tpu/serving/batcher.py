@@ -169,6 +169,7 @@ class MicroBatcher:
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._closed = False
+        self._dead_workers: List[str] = []  # "name: exc" per crashed worker
         self._workers = [
             threading.Thread(target=self._run, args=(rep,),
                              name="mxtpu-serving-%d" % i, daemon=True)
@@ -200,6 +201,13 @@ class MicroBatcher:
     def queue_depth(self):
         with self._cv:
             return len(self._q)
+
+    def dead_workers(self):
+        """``["thread-name: exception", ...]`` for worker threads that died
+        on an unexpected error (health endpoints report these as degraded
+        capacity — the server still works through its surviving replicas)."""
+        with self._cv:
+            return list(self._dead_workers)
 
     def stop(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop accepting work; with ``drain`` the workers flush whatever
@@ -244,13 +252,24 @@ class MicroBatcher:
             return batch
 
     def _run(self, replica):
-        while True:
-            batch = self._collect()
-            if batch is None:
-                return
-            if not batch:
-                continue
-            self._execute(replica, batch)
+        # _execute already confines per-batch executor failures to the
+        # affected futures; anything escaping to here kills this replica's
+        # thread, so record it — a fully-working-looking server with dead
+        # workers is exactly the failure mode /healthz must surface
+        try:
+            while True:
+                batch = self._collect()
+                if batch is None:
+                    return
+                if not batch:
+                    continue
+                self._execute(replica, batch)
+        except BaseException as exc:
+            with self._cv:
+                self._dead_workers.append(
+                    "%s: %r" % (threading.current_thread().name, exc))
+            self._metrics.on_worker_crash()
+            raise
 
     def _execute(self, replica, batch):
         now = time.monotonic()

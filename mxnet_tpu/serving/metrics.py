@@ -52,6 +52,7 @@ class ServingMetrics:
         self.requests_expired = 0
         self.requests_failed = 0
         self.requests_completed = 0
+        self.worker_crashes = 0
         self.batches_total = 0
         self.padded_items_total = 0
         self.queue_depth = 0
@@ -79,6 +80,10 @@ class ServingMetrics:
     def on_fail(self, n=1):
         with self._lock:
             self.requests_failed += n
+
+    def on_worker_crash(self):
+        with self._lock:
+            self.worker_crashes += 1
 
     def on_dequeue(self, queue_depth):
         with self._lock:
@@ -124,6 +129,7 @@ class ServingMetrics:
                 "requests_rejected": self.requests_rejected,
                 "requests_expired": self.requests_expired,
                 "requests_failed": self.requests_failed,
+                "worker_crashes": self.worker_crashes,
                 "batches_total": self.batches_total,
                 "padded_items_total": self.padded_items_total,
                 "queue_depth": self.queue_depth,
@@ -141,7 +147,7 @@ class ServingMetrics:
         lines = []
         for key in ("requests_total", "requests_completed",
                     "requests_rejected", "requests_expired",
-                    "requests_failed", "batches_total",
+                    "requests_failed", "worker_crashes", "batches_total",
                     "padded_items_total"):
             lines.append("# TYPE mxtpu_serving_%s counter" % key)
             lines.append("mxtpu_serving_%s %d" % (key, s[key]))

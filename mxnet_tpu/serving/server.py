@@ -166,6 +166,12 @@ class InferenceServer:
     def queue_depth(self):
         return self._batcher.queue_depth()
 
+    def health(self):
+        """``("ok", [])`` when every replica worker is alive, else
+        ``("degraded", [detail, ...])`` listing the dead workers."""
+        dead = self._batcher.dead_workers()
+        return ("degraded" if dead else "ok", dead)
+
     def metrics_text(self):
         return self.metrics.render_text()
 
@@ -178,7 +184,11 @@ class InferenceServer:
           "deadline_ms": optional}`` → ``{"outputs": [...]}``; 503 when
           the queue is full (retry with backoff), 504 past deadline.
         * ``GET /metrics`` — Prometheus text.
-        * ``GET /healthz`` — liveness.
+        * ``GET /healthz`` — liveness: 200 ``ok`` when every replica
+          worker thread is alive; 503 with a JSON
+          ``{"status": "degraded", "dead_workers": [...]}`` body when one
+          has died (the server limps on through surviving replicas, but
+          the orchestrator should recycle it).
         """
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -201,7 +211,12 @@ class InferenceServer:
                     self._reply(200, server.metrics_text(),
                                 ctype="text/plain; version=0.0.4")
                 elif self.path == "/healthz":
-                    self._reply(200, "ok", ctype="text/plain")
+                    status, dead = server.health()
+                    if status == "ok":
+                        self._reply(200, "ok", ctype="text/plain")
+                    else:
+                        self._reply(503, json.dumps(
+                            {"status": "degraded", "dead_workers": dead}))
                 else:
                     self._reply(404, json.dumps({"error": "not found"}))
 

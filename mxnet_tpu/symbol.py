@@ -445,8 +445,10 @@ class Symbol:
                            "attrs": {"mxnet_version": ["int", 901]}}, indent=2)
 
     def save(self, fname: str) -> None:
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        from .filesystem import atomic_write
+
+        payload = self.tojson().encode("utf-8")
+        atomic_write(fname, lambda f: f.write(payload), op="symbol.write")
 
     # ------------------------------------------------------------------
     # binding

@@ -1,0 +1,21 @@
+"""Standalone kvstore server process for chaos tests — the kill -9 target.
+
+Usage: chaos_kv_server.py HOST PORT SNAPSHOT_PATH
+
+Serves until a cooperative stop command (exit 0) or an external SIGKILL;
+on restart with the same SNAPSHOT_PATH it restores the journaled state.
+"""
+import sys
+
+
+def main():
+    host, port, snap = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    from mxnet_tpu import kvstore_server as kvs
+
+    srv = kvs.KVStoreServer(host, port, num_workers=1, sync_mode=False,
+                            snapshot_path=snap, snapshot_interval=0)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

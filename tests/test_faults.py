@@ -1,0 +1,257 @@
+"""Fault-injection engine + durable-write/corruption-detection tests.
+
+The deterministic half of the chaos story: FaultPlan decisions are pure
+functions of (spec, seed, call index), atomic_write leaves only
+old-complete or new-complete bytes behind, and every reader that
+discovers persisted artifacts (checkpoints, RecordIO, kv snapshots)
+rejects torn or corrupted files instead of loading garbage.
+"""
+
+import os
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults
+from mxnet_tpu.faults import (FaultPlan, InjectedConnectionError,
+                              InjectedIOError, parse_spec)
+from mxnet_tpu import filesystem as fs
+from mxnet_tpu.recordio import MXRecordIO, RecordIOCorruptError
+
+
+# -- spec grammar -----------------------------------------------------------
+
+def test_parse_spec_grammar():
+    rules = parse_spec("kv.client.*:drop=0.3;ckpt.write:partial=1@0.5,"
+                       "ioerr=0.1;*:delay=1@10ms")
+    assert [(r.op, r.kind) for r in rules] == [
+        ("kv.client.*", "drop"), ("ckpt.write", "partial"),
+        ("ckpt.write", "ioerr"), ("*", "delay")]
+    assert rules[0].rate == 0.3
+    assert rules[1].param == 0.5
+    assert rules[3].param == pytest.approx(0.01)  # 10ms -> seconds
+
+
+def test_parse_spec_nth_trigger_and_errors():
+    (rule,) = parse_spec("kv.client.recv:drop=1@#2")
+    assert rule.nth == 2 and rule.param is None
+    with pytest.raises(ValueError, match="bad fault rule"):
+        parse_spec("no-colon-here")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        parse_spec("op:explode=1")
+
+
+# -- decision engine --------------------------------------------------------
+
+def _decision_trace(plan, ops):
+    out = []
+    for op in ops:
+        try:
+            plan.fire(op)
+            out.append(None)
+        except (InjectedConnectionError, InjectedIOError) as e:
+            out.append(type(e).__name__)
+    return out
+
+
+def test_same_seed_same_decisions():
+    ops = ["kv.client.send", "kv.client.recv"] * 50
+    t1 = _decision_trace(FaultPlan("kv.client.*:drop=0.5", seed=11), ops)
+    t2 = _decision_trace(FaultPlan("kv.client.*:drop=0.5", seed=11), ops)
+    t3 = _decision_trace(FaultPlan("kv.client.*:drop=0.5", seed=12), ops)
+    assert t1 == t2
+    assert t1 != t3  # astronomically unlikely to collide over 100 draws
+    assert any(t1)
+
+
+def test_rule_streams_are_independent():
+    """Interleaving calls to OTHER ops must not shift a rule's decision
+    sequence — each rule draws from its own seeded stream."""
+    spec = "a.x:drop=0.5;b.*:drop=0.5"
+    plain = _decision_trace(FaultPlan(spec, seed=3), ["a.x"] * 40)
+    mixed_ops = []
+    for _ in range(40):
+        mixed_ops += ["a.x", "b.y", "b.y"]
+    mixed = _decision_trace(FaultPlan(spec, seed=3), mixed_ops)
+    assert [d for op, d in zip(mixed_ops, mixed) if op == "a.x"] == plain
+
+
+def test_nth_trigger_fires_exactly_once():
+    plan = FaultPlan("kv.client.recv:drop=1@#3", seed=0)
+    trace = _decision_trace(plan, ["kv.client.recv"] * 6)
+    assert trace == [None, None, "InjectedConnectionError",
+                     None, None, None]
+    assert plan.events == [("kv.client.recv", "drop", 3)]
+
+
+def test_inject_scoping_restores_previous_plan():
+    assert faults.active() is None
+    with faults.inject("x:drop=1"):
+        assert faults.active() is not None
+        with pytest.raises(InjectedConnectionError):
+            faults.fire("x")
+        with faults.inject("y:ioerr=1") as inner:
+            assert faults.active() is inner
+            faults.fire("x")  # old plan no longer consulted
+        with pytest.raises(InjectedConnectionError):
+            faults.fire("x")
+    assert faults.active() is None
+    faults.fire("x")  # inactive: must be a no-op
+
+
+def test_install_from_env(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULTS_SPEC", "env.op:ioerr=1")
+    monkeypatch.setenv("MXNET_FAULTS_SEED", "5")
+    try:
+        plan = faults.install_from_env()
+        assert plan is not None and plan.seed == 5
+        with pytest.raises(InjectedIOError):
+            faults.fire("env.op")
+    finally:
+        faults.uninstall()
+
+
+# -- atomic writes + CRC sidecars -------------------------------------------
+
+def test_atomic_write_success_and_sidecar(tmp_path):
+    p = str(tmp_path / "state.bin")
+    fs.atomic_write(p, lambda f: f.write(b"hello world"), checksum=True)
+    assert open(p, "rb").read() == b"hello world"
+    assert fs.verify_crc_sidecar(p) is True
+    # silent corruption after the fact is caught by the sidecar
+    with open(p, "r+b") as f:
+        f.write(b"J")
+    assert fs.verify_crc_sidecar(p) is False
+    assert fs.verify_crc_sidecar(str(tmp_path / "nosidecar")) is None
+
+
+def test_atomic_write_torn_write_leaves_old_file_intact(tmp_path):
+    p = str(tmp_path / "ckpt.params")
+    fs.atomic_write(p, lambda f: f.write(b"GOOD" * 64), op="ckpt.write")
+    with faults.inject("ckpt.write:partial=1@0.5"):
+        with pytest.raises(InjectedIOError, match="torn write"):
+            fs.atomic_write(p, lambda f: f.write(b"NEWDATA" * 64),
+                            op="ckpt.write")
+    # the visible file is still the OLD complete version
+    assert open(p, "rb").read() == b"GOOD" * 64
+    # ...and the torn temp is around, truncated, as after a real crash
+    torn = "%s.tmp.%d" % (p, os.getpid())
+    assert os.path.exists(torn)
+    assert len(open(torn, "rb").read()) == len(b"NEWDATA" * 64) // 2
+
+
+def test_nd_save_is_atomic_under_injected_crash(tmp_path):
+    p = str(tmp_path / "w.params")
+    good = {"w": mx.nd.array(np.arange(8, dtype=np.float32))}
+    mx.nd.save(p, good)
+    with faults.inject("params.write:ioerr=1@#1"):
+        with pytest.raises(InjectedIOError):
+            mx.nd.save(p, {"w": mx.nd.array(np.zeros(8, np.float32))})
+    loaded = mx.nd.load(p)
+    np.testing.assert_array_equal(loaded["w"].asnumpy(),
+                                  np.arange(8, dtype=np.float32))
+
+
+# -- checkpoint discovery skips corrupt files -------------------------------
+
+def test_find_latest_checkpoint_skips_corrupt(tmp_path):
+    import jax.numpy as jnp
+
+    prefix = str(tmp_path / "model")
+    arg = {"w": mx.nd.array(jnp.ones((2, 2)))}
+    mx.model.save_checkpoint(prefix, 1, None, arg, {})
+    assert fs.verify_crc_sidecar("%s-0001.params" % prefix) is True
+    mx.model.save_checkpoint(prefix, 2, None, arg, {})
+    # epoch 2 gets torn after the save (bit rot / partial copy): the CRC
+    # sidecar no longer matches
+    with open("%s-0002.params" % prefix, "r+b") as f:
+        f.truncate(10)
+    # epoch 3 is a sidecar-less impostor with garbage bytes: rejected by
+    # the container-magic sniff
+    with open("%s-0003.params" % prefix, "wb") as f:
+        f.write(b"not a params file")
+    assert mx.model.find_latest_checkpoint(prefix) == 1
+
+
+def test_save_checkpoint_atomic_under_torn_write(tmp_path):
+    import jax.numpy as jnp
+
+    prefix = str(tmp_path / "net")
+    mx.model.save_checkpoint(prefix, 1, None,
+                             {"w": mx.nd.array(jnp.full((3,), 7.0))}, {})
+    with faults.inject("ckpt.write:partial=1@0.4"):
+        with pytest.raises(InjectedIOError):
+            mx.model.save_checkpoint(
+                prefix, 1, None,
+                {"w": mx.nd.array(jnp.zeros((3,)))}, {})
+    # resume still finds the intact epoch and loads the OLD weights
+    assert mx.model.find_latest_checkpoint(prefix) == 1
+    loaded = mx.nd.load("%s-0001.params" % prefix)
+    np.testing.assert_array_equal(loaded["arg:w"].asnumpy(),
+                                  np.full((3,), 7.0))
+
+
+def test_sharded_checkpoint_incomplete_dir_is_rejected(tmp_path):
+    from mxnet_tpu import checkpoint as ckpt
+
+    path = tmp_path / "m-0001.orbax"
+    path.mkdir()  # a crash-torn orbax dir: exists but never committed
+    (path / "somefile").write_bytes(b"partial")
+    with pytest.raises(mx.MXNetError, match="incomplete"):
+        ckpt.load_sharded_checkpoint(str(tmp_path / "m"), 1)
+
+
+# -- RecordIO corruption ----------------------------------------------------
+
+def _write_records(path, payloads):
+    w = MXRecordIO(path, "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+
+
+def test_recordio_truncated_trailing_record_raises_with_offset(tmp_path):
+    p = str(tmp_path / "data.rec")
+    _write_records(p, [b"a" * 32, b"b" * 32])
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.truncate(size - 30)  # tear the second record's payload
+    r = MXRecordIO(p, "r")
+    assert r.read() == b"a" * 32
+    with pytest.raises(RecordIOCorruptError) as ei:
+        r.read()
+    assert ei.value.offset == 40  # second record starts after 8+32 bytes
+    assert "byte offset 40" in str(ei.value)
+    r.close()
+    # a trailing partial HEADER (writer died inside the 8-byte head) is
+    # also corruption, not silent end-of-stream
+    with open(p, "r+b") as f:
+        f.truncate(43)
+    r = MXRecordIO(p, "r")
+    assert r.read() == b"a" * 32
+    with pytest.raises(RecordIOCorruptError, match="trailing record header"):
+        r.read()
+    r.close()
+
+
+def test_recordio_bad_magic_raises_with_offset(tmp_path):
+    p = str(tmp_path / "data.rec")
+    _write_records(p, [b"x" * 8])
+    with open(p, "r+b") as f:
+        f.write(struct.pack("<I", 0xdeadbeef))
+    r = MXRecordIO(p, "r")
+    with pytest.raises(RecordIOCorruptError, match="invalid RecordIO magic"):
+        r.read()
+    r.close()
+
+
+def test_recordio_clean_eof_still_returns_none(tmp_path):
+    p = str(tmp_path / "data.rec")
+    _write_records(p, [b"one"])
+    r = MXRecordIO(p, "r")
+    assert r.read() == b"one"
+    assert r.read() is None
+    r.close()

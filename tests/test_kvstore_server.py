@@ -464,3 +464,44 @@ def test_recovery_flag_expires_at_first_push(monkeypatch):
             kv.close()
     finally:
         srv.stop()
+
+
+def test_client_close_idempotent_and_context_manager():
+    """close() is safe to call any number of times, __exit__ closes, the
+    heartbeat thread is joined on close, and a closed client refuses
+    further RPCs instead of hanging on a dead socket."""
+    srv = kvs.start_server(num_workers=1)
+    try:
+        host, port = srv.addr
+        with kvs.ServerClient(host, port) as c:
+            c.init(1, np.ones(2, np.float32))
+            c.start_heartbeat(0, interval=0.05)
+            hb = c._hb_thread
+            assert hb is not None and hb.is_alive()
+        # the context exit ran close(): heartbeat joined, socket dropped
+        assert c._closed
+        assert c._hb_thread is None and not hb.is_alive()
+        assert c._sock is None
+        c.close()  # second (and third) close: no-op, no exception
+        c.close()
+        with pytest.raises(ConnectionError, match="closed"):
+            c.pull(1)
+    finally:
+        srv.stop()
+
+
+def test_client_reconnects_through_server_socket_loss():
+    """Dropping the established TCP connection under the client must be
+    invisible to the caller: the next RPC reconnects and replays."""
+    srv = kvs.start_server(num_workers=1)
+    try:
+        host, port = srv.addr
+        with kvs.ServerClient(host, port) as c:
+            c.init(2, np.full(3, 4.0, np.float32))
+            # sever the transport out from under the client
+            c._sock.shutdown(__import__("socket").SHUT_RDWR)
+            c._sock.close()
+            out = c.pull(2)  # reconnect + replay, not an exception
+            np.testing.assert_array_equal(out, np.full(3, 4.0, np.float32))
+    finally:
+        srv.stop()

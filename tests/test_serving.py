@@ -306,3 +306,55 @@ def test_from_checkpoint(tmp_path):
         np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-6)
     finally:
         srv.stop()
+
+
+def test_healthz_degraded_when_worker_thread_dies():
+    """A dead replica worker must flip /healthz to 503 degraded (with the
+    dead thread named) and bump the worker_crashes counter — a server
+    that looks alive but silently lost its executor loop is the failure
+    mode health checks exist for."""
+    import urllib.error
+
+    net, params = _tiny_model()
+    srv = serving.InferenceServer(net, dict(params), {"data": (4, IN_DIM)},
+                                  max_wait_us=1000, warmup=False)
+    try:
+        host, port = srv.serve_http()
+        base = "http://%s:%d" % (host, port)
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as h:
+            assert h.read() == b"ok"
+        assert srv.health() == ("ok", [])
+
+        # make the worker's NEXT _collect() blow up; the current request
+        # completes normally, then the loop crashes
+        def boom():
+            raise RuntimeError("injected worker crash")
+
+        prev_hook = threading.excepthook  # keep the traceback out of logs
+        threading.excepthook = lambda args: None
+        try:
+            srv._batcher._collect = boom
+            srv.predict(data=np.zeros(IN_DIM, np.float32))
+            deadline = time.monotonic() + 10.0
+            while not srv._batcher.dead_workers():
+                assert time.monotonic() < deadline, "worker never died"
+                time.sleep(0.02)
+        finally:
+            threading.excepthook = prev_hook
+
+        status, dead = srv.health()
+        assert status == "degraded"
+        assert any("injected worker crash" in d for d in dead)
+        assert srv.metrics.snapshot()["worker_crashes"] == 1
+        try:
+            urllib.request.urlopen(base + "/healthz", timeout=10)
+            raise AssertionError("expected HTTP 503")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 503
+            body = json.loads(exc.read())
+            assert body["status"] == "degraded"
+            assert body["dead_workers"]
+        text = srv.metrics_text()
+        assert "mxtpu_serving_worker_crashes 1" in text
+    finally:
+        srv.stop()

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Replay (or sweep) fault-injection seeds against a training command.
+
+A chaos test that fails reports its (spec, seed); this tool reruns the
+exact same fault schedule — the FaultPlan decision for the N-th matching
+call is a pure function of (spec, seed, N), so the failure reproduces
+outside pytest where it can be debugged:
+
+    # replay the failing schedule
+    python tools/chaos_run.py --spec "kv.client.*:drop=0.3" --seed 7 -- \\
+        python tools/launch.py -n 2 -s 1 python train.py
+
+    # sweep seeds 0..19 hunting for a schedule that breaks the job
+    python tools/chaos_run.py --spec "kv.client.*:drop=0.3" --seeds 0:20 -- \\
+        python train.py
+
+The spec/seed reach the command (and every child it spawns, e.g. via
+tools/launch.py) through MXNET_FAULTS_SPEC / MXNET_FAULTS_SEED, which
+mxnet_tpu.faults reads at import.  See docs/how_to/fault_tolerance.md
+for the spec grammar.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Run a command under a deterministic fault schedule",
+        usage="chaos_run.py --spec SPEC (--seed N | --seeds A:B) "
+              "[--timeout S] -- command ...")
+    parser.add_argument("--spec", required=True,
+                        help="fault spec, e.g. 'kv.client.*:drop=0.3'")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="replay one seed")
+    parser.add_argument("--seeds", type=str, default=None, metavar="A:B",
+                        help="sweep seeds A..B-1, report pass/fail each")
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="per-run timeout in seconds")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given (put it after --)")
+    if (args.seed is None) == (args.seeds is None):
+        parser.error("exactly one of --seed / --seeds is required")
+
+    if args.seeds is not None:
+        a, _, b = args.seeds.partition(":")
+        seeds = range(int(a), int(b))
+    else:
+        seeds = [args.seed]
+
+    # validate the spec before burning any runtime on it
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu.faults import parse_spec
+
+    parse_spec(args.spec)
+
+    failures = []
+    for seed in seeds:
+        env = dict(os.environ,
+                   MXNET_FAULTS_SPEC=args.spec,
+                   MXNET_FAULTS_SEED=str(seed))
+        print("chaos_run: seed %d, spec %r" % (seed, args.spec),
+              file=sys.stderr, flush=True)
+        try:
+            rc = subprocess.run(command, env=env,
+                                timeout=args.timeout).returncode
+        except subprocess.TimeoutExpired:
+            rc = -1
+            print("chaos_run: seed %d TIMED OUT" % seed,
+                  file=sys.stderr, flush=True)
+        status = "ok" if rc == 0 else "FAILED rc=%d" % rc
+        print("chaos_run: seed %d -> %s" % (seed, status),
+              file=sys.stderr, flush=True)
+        if rc != 0:
+            failures.append(seed)
+    if failures:
+        print("chaos_run: failing seeds: %s  (replay one with --seed N)"
+              % failures, file=sys.stderr, flush=True)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -135,11 +135,13 @@ def main():
     procs = []
     server_procs = []
     worker_envs = []
+    server_envs = []
     try:
         for i in range(args.num_servers):
             env = dict(base_env)
             env["DMLC_ROLE"] = "server"
             env["DMLC_SERVER_ID"] = str(i)
+            server_envs.append(env)
             server_procs.append(spawn(env, i))
         for i in range(args.num_workers):
             env = dict(base_env)
@@ -150,13 +152,32 @@ def main():
         rc = 0
         if args.auto_resume:
             # supervise: a crashed worker comes back (its script resumes
-            # from the newest checkpoint); clean exits retire normally
+            # from the newest checkpoint) and a crashed SERVER comes back
+            # too (restoring its state from MXNET_KVSTORE_SNAPSHOT_PATH if
+            # configured — workers ride out the outage through their
+            # idempotent-retry transport, no worker restarts needed);
+            # clean exits retire normally
             import time
 
             attempts = [0] * args.num_workers
+            srv_attempts = [0] * args.num_servers
             live = dict(enumerate(procs))
             while live:
                 time.sleep(0.2)
+                for i, p in list(enumerate(server_procs)):
+                    r = p.poll()
+                    if r is None or r == 0:
+                        continue
+                    if srv_attempts[i] >= args.auto_resume:
+                        continue
+                    srv_attempts[i] += 1
+                    env = dict(server_envs[i])
+                    env["MXNET_AUTORESUME_ATTEMPT"] = str(srv_attempts[i])
+                    print("launch.py: server %d exited rc=%d; "
+                          "relaunch %d/%d" % (i, r, srv_attempts[i],
+                                              args.auto_resume),
+                          file=sys.stderr, flush=True)
+                    server_procs[i] = spawn(env, i)
                 for i, p in list(live.items()):
                     r = p.poll()
                     if r is None:
