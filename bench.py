@@ -77,6 +77,10 @@ def _arg_parser():
                     help="seconds before the ResNet subprocess is killed")
     ap.add_argument("--lm-timeout", type=int, default=2400,
                     help="seconds before the LM subprocess is killed")
+    ap.add_argument("--skip-kvstore", action="store_true",
+                    help="omit the CPU-only kvstore transport phase")
+    ap.add_argument("--kvstore-timeout", type=int, default=240,
+                    help="seconds before the kvstore subprocess is killed")
     return ap
 
 
@@ -319,6 +323,36 @@ def _run_phase(phase, cli, timeout):
                                    "; ".join(tail[-2:])[:300])}
 
 
+def _kvstore_fields(timeout=240):
+    """CPU-only kvstore transport phase (tools/bench_kvstore.py) in a
+    subprocess: sync vs async vs async+bucketed push/pull throughput
+    over many small keys. Needs no accelerator, so the comm-engine perf
+    trajectory gets numbers even when the TPU tunnel is down."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_kvstore.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run([sys.executable, script],
+                              capture_output=True, text=True,
+                              timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return {"kvstore_error":
+                "kvstore phase killed after %ds" % timeout}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        return {"kvstore_pushpull_ops_s": rec.get("async_bucket_ops_s"),
+                "kvstore_sync_ops_s": rec.get("sync_ops_s"),
+                "kvstore_async_ops_s": rec.get("async_ops_s"),
+                "kvstore_speedup_async": rec.get("speedup_async"),
+                "kvstore_speedup_bucket": rec.get("speedup_bucket")}
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return {"kvstore_error": "rc=%d %s" % (proc.returncode,
+                                           "; ".join(tail[-2:])[:300])}
+
+
 def _probe_backend(timeout=300):
     """Claim and release the backend in a subprocess. Returns None when
     healthy, else a short error string."""
@@ -355,11 +389,19 @@ def orchestrate(argv=None):
         return {"metric": "transformer_lm_train_mfu", "value": 0.0,
                 "unit": "MFU", "vs_baseline": 0.0, "error": msg[:300]}
 
+    # CPU-only transport phase FIRST: it needs no accelerator, so its
+    # numbers survive every early return below (dead tunnel included)
+    kv_fields = {} if cli.skip_kvstore else \
+        _kvstore_fields(cli.kvstore_timeout)
+
+    def finish(rec):
+        rec.update(kv_fields)
+        print(json.dumps(rec))
+        return rec
+
     err = _probe_backend()
     if err:
-        record = error_record(err)
-        print(json.dumps(record))
-        return record
+        return finish(error_record(err))
 
     if not cli.skip_transformer:
         record.update(_run_phase("lm", cli, cli.lm_timeout))
@@ -377,8 +419,7 @@ def orchestrate(argv=None):
                 record = error_record(
                     "tunnel died during the LM phase: %s"
                     % record.get("lm_error"))
-            print(json.dumps(record))
-            return record
+            return finish(record)
 
     resnet = _run_phase("resnet", cli, cli.resnet_timeout)
     metric_fields = {k: resnet.pop(k, None) for k in
@@ -394,8 +435,7 @@ def orchestrate(argv=None):
                   "unit": "MFU", "vs_baseline": 0.0,
                   "error": "; ".join(str(record[k]) for k in record
                                      if k.endswith("_error"))[:300]}
-    print(json.dumps(record))
-    return record
+    return finish(record)
 
 
 if __name__ == "__main__":

@@ -75,6 +75,7 @@ from . import kvstore as kv
 # server-role bootstrap: under DMLC_ROLE=server this serves and exits
 # (reference python/mxnet/kvstore_server.py:58 _init_kvstore_server_module)
 from . import kvstore_server
+from . import comm_engine
 from . import model
 from . import module
 from . import module as mod

@@ -340,6 +340,21 @@ class PrefetchingIter(DataIter):
         self._threads = []
         self._queues = []
 
+    def close(self):
+        """Tear the worker threads down NOW — for abandoning an epoch
+        mid-iteration (early stop, exception unwind), where waiting for
+        ``reset()`` or garbage collection would leave producers parked on
+        live queues holding their sources.  Idempotent; a later
+        ``reset()`` restarts the pipeline."""
+        self._tear_down(wait=True)
+        self._exhausted = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
     def __del__(self):
         try:
             self._tear_down(wait=False)

@@ -34,6 +34,7 @@ import struct
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from typing import Dict, Optional
 
 import numpy as np
@@ -65,6 +66,9 @@ register_env("MXNET_KVSTORE_SNAPSHOT_PATH", "", str,
 register_env("MXNET_KVSTORE_SNAPSHOT_INTERVAL", 30, float,
              "Seconds between periodic server snapshots; <= 0 snapshots "
              "only on demand and clean stop.")
+register_env("MXNET_KVSTORE_DEDUP_WINDOW", 4096, int,
+             "Completed idempotency records kept per client for replay "
+             "matching on the pipelined transport.")
 
 
 # -- retry/backoff knobs (docs/how_to/fault_tolerance.md) -------------------
@@ -101,6 +105,16 @@ def _backoff_sleep(attempt, conf):
 _WIRE_VERSION = 1
 _HDR = struct.Struct("<QI")
 _LEN = struct.Struct("<Q")
+
+
+def _nodelay(sock):
+    """Small request/reply frames: without TCP_NODELAY the Nagle +
+    delayed-ACK interaction adds ~40ms to every per-key round trip."""
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass
+    return sock
 
 
 def _send_msg(sock, obj, op=None):
@@ -191,10 +205,12 @@ class KVStoreServer:
         # ps::Postoffice node tracking behind GetDeadNodes,
         # kvstore_dist.h:151-160)
         self._heartbeats: Dict[int, float] = {}
-        # idempotency records: client_id -> {"seq", "done", "reply"} for
-        # that client's newest request.  Clients issue requests serially
-        # (one in flight, strictly increasing seq), so one record per
-        # client is complete dedup state.
+        # idempotency records: client_id -> {"floor", "window"} where
+        # window is an OrderedDict seq -> {"done", "reply"}.  The pipelined
+        # client keeps MANY requests in flight, so dedup must remember a
+        # window of completed seqs (MXNET_KVSTORE_DEDUP_WINDOW), not just
+        # the newest; "floor" rises as done entries are evicted, and any
+        # retried seq at or below it is definitively stale.
         self._dedup: Dict[str, dict] = {}
         self._dedup_cv = threading.Condition()
         self.applied_pushes = 0  # distinct (non-replayed) push applications
@@ -209,17 +225,49 @@ class KVStoreServer:
         server_self = self
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                _nodelay(self.request)
+
             def handle(self):
+                # pipelined connections: enveloped requests are answered
+                # as ("rsp", seq, reply) so the client's reader thread can
+                # match replies to in-flight tokens out of order; raw
+                # (unenveloped) messages keep the legacy lockstep reply.
+                # The send lock serializes writers: the main loop and any
+                # parked barrier threads share this socket.
+                send_lock = threading.Lock()
+                sock = self.request
+
+                def respond(wrapped, seq, reply):
+                    out = ("rsp", seq, reply) if wrapped else reply
+                    with send_lock:
+                        _send_msg(sock, out, op="kv.server.send")
+
                 try:
                     while True:
-                        msg = _recv_msg(self.request, op="kv.server.recv")
+                        msg = _recv_msg(sock, op="kv.server.recv")
                         if isinstance(msg, tuple) and msg and \
                                 msg[0] == "req":
                             _, cid, seq, inner = msg
+                            wrapped = True
                         else:
                             cid, seq, inner = None, None, msg
+                            wrapped = False
+                        if wrapped and inner[0] == "barrier":
+                            # a barrier parks for up to minutes; serve it
+                            # off-thread so pipelined pushes/pulls behind
+                            # it keep flowing on this connection
+                            def run(cid=cid, seq=seq, inner=inner):
+                                try:
+                                    respond(True, seq, server_self.
+                                            _serve_one(cid, seq, inner))
+                                except (ConnectionError, OSError):
+                                    pass
+
+                            threading.Thread(target=run, daemon=True).start()
+                            continue
                         reply = server_self._serve_one(cid, seq, inner)
-                        _send_msg(self.request, reply, op="kv.server.send")
+                        respond(wrapped, seq, reply)
                         if inner[0] == "stop":
                             break
                 except (ConnectionError, OSError):
@@ -243,27 +291,48 @@ class KVStoreServer:
         """Dispatch one request, deduplicating retries by (cid, seq).  A
         replayed token returns the recorded reply (waiting out a still-
         running original, e.g. a barrier whose connection died while
-        parked) without re-running the command."""
+        parked) without re-running the command.  Pipelined clients keep
+        many tokens in flight, so records live in a per-client window of
+        completed seqs rather than a single newest-seq slot."""
         if cid is None:
             return self._dispatch_safe(msg)
         with self._dedup_cv:
-            ent = self._dedup.get(cid)
-            if ent is not None and seq == ent["seq"]:
+            rec = self._dedup.setdefault(
+                cid, {"floor": 0, "window": OrderedDict()})
+            ent = rec["window"].get(seq)
+            if ent is not None:
                 while not ent["done"]:
                     self._dedup_cv.wait(0.1)
                 return ent["reply"]
-            if ent is not None and seq < ent["seq"]:
-                return ("err", "stale request token %s < %s (client %s)"
-                        % (seq, ent["seq"], cid))
-            ent = {"seq": seq, "done": False, "reply": None}
-            self._dedup[cid] = ent
+            if seq <= rec["floor"]:
+                return ("err", "stale request token %s <= %s (client %s)"
+                        % (seq, rec["floor"], cid))
+            ent = {"done": False, "reply": None}
+            rec["window"][seq] = ent
         reply = self._dispatch_safe(msg)
         with self._dedup_cv:
-            if self._dedup.get(cid) is ent:
+            if rec["window"].get(seq) is ent:
                 ent["reply"] = reply
                 ent["done"] = True
+                self._evict_dedup_locked(rec)
                 self._dedup_cv.notify_all()
         return reply
+
+    @staticmethod
+    def _evict_dedup_locked(rec):
+        """Trim a client's dedup window to MXNET_KVSTORE_DEDUP_WINDOW done
+        entries, raising the stale floor past what falls off.  A pending
+        entry stops eviction — its token must stay replayable."""
+        limit = max(1, int(os.environ.get("MXNET_KVSTORE_DEDUP_WINDOW",
+                                          "4096")))
+        win = rec["window"]
+        while len(win) > limit:
+            s, e = next(iter(win.items()))
+            if not e["done"]:
+                break
+            del win[s]
+            if s > rec["floor"]:
+                rec["floor"] = s
 
     def _dispatch_safe(self, msg):
         try:
@@ -279,10 +348,22 @@ class KVStoreServer:
             with self._lock:
                 self.store.setdefault(key, np.array(arr))
             return ("ok",)
+        if cmd == "multi":
+            # fused bucket of inner commands (gradient coalescing): ONE
+            # envelope = ONE dedup record, so exactly-once replay covers
+            # the whole bucket atomically from the client's perspective
+            return ("ok", [self._dispatch_safe(m) for m in msg[1]])
         if cmd == "push":
             key, arr = msg[1], msg[2]
             rank = msg[3] if len(msg) > 3 else 0
             with self._lock:
+                stored = self.store.get(key)
+                if stored is not None and \
+                        np.asarray(arr).dtype != stored.dtype:
+                    # fp16 wire compression: decompress to the stored
+                    # dtype before merging/updating so server-side math
+                    # runs at full precision
+                    arr = np.asarray(arr, dtype=stored.dtype)
                 self.applied_pushes += 1
                 if self.sync_mode:
                     # per-worker rounds: a fast worker's next-iteration push
@@ -419,7 +500,10 @@ class KVStoreServer:
         self.store[key] = weight.asnumpy()
 
     # -- durable snapshots --------------------------------------------------
-    _SNAP_VERSION = 1
+    # v2: dedup records are per-client windows {"floor", "window": {seq:
+    # reply}} (pipelined transport); v1 single-record snapshots are
+    # converted on restore
+    _SNAP_VERSION = 2
 
     def snapshot(self):
         """Write the full server state to ``snapshot_path`` atomically
@@ -441,9 +525,11 @@ class KVStoreServer:
                             if self.updater is not None else None)
             applied = self.applied_pushes
         with self._dedup_cv:
-            dedup = {cid: {"seq": e["seq"], "done": True,
-                           "reply": e["reply"]}
-                     for cid, e in self._dedup.items() if e["done"]}
+            dedup = {cid: {"floor": rec["floor"],
+                           "window": {s: e["reply"]
+                                      for s, e in rec["window"].items()
+                                      if e["done"]}}
+                     for cid, rec in self._dedup.items()}
         state = {
             "version": self._SNAP_VERSION,
             "store": store,
@@ -476,7 +562,7 @@ class KVStoreServer:
         try:
             with open(path, "rb") as f:
                 state = pickle.load(f)
-            if state.get("version") != self._SNAP_VERSION:
+            if state.get("version") not in (1, self._SNAP_VERSION):
                 raise ValueError("snapshot version %r"
                                  % (state.get("version"),))
             updater = (pickle.loads(state["updater"])
@@ -494,10 +580,28 @@ class KVStoreServer:
         with self._barrier_cv:
             self._barrier_gen = int(state.get("barrier_gen", 0))
         with self._dedup_cv:
-            self._dedup = dict(state.get("dedup", {}))
+            self._dedup = self._load_dedup(state.get("dedup", {}),
+                                           state.get("version"))
         logging.info("kvstore server restored %d keys (barrier gen %d) "
                      "from %s", len(self.store), self._barrier_gen, path)
         return True
+
+    @staticmethod
+    def _load_dedup(raw, version):
+        """Rebuild live dedup records from a snapshot; v1 snapshots hold a
+        single {"seq", "done", "reply"} record per client."""
+        out = {}
+        for cid, rec in raw.items():
+            if version == 1 or "window" not in rec:
+                win = OrderedDict()
+                win[rec["seq"]] = {"done": True, "reply": rec["reply"]}
+                out[cid] = {"floor": rec["seq"] - 1, "window": win}
+                continue
+            win = OrderedDict()
+            for s in sorted(rec["window"]):
+                win[s] = {"done": True, "reply": rec["window"][s]}
+            out[cid] = {"floor": int(rec.get("floor", 0)), "window": win}
+        return out
 
     def _snapshot_loop(self):
         while not self._stop.wait(self._snap_interval):
@@ -528,16 +632,21 @@ class KVStoreServer:
 class ServerClient:
     """Worker-side connection to a KVStoreServer (the ps::KVWorker role).
 
-    Crash-tolerant transport: every RPC carries an idempotency token
-    ``(client_id, seq)``; on any connection failure the client reconnects
-    with exponential backoff + jitter (``MXNET_KVSTORE_RETRY_*``) and
-    replays the SAME token, which the server deduplicates — so a retried
-    ``push`` after a dropped ACK is applied exactly once, and a server
-    kill+restart (snapshot recovery) is ridden out transparently as long
-    as it returns within the retry budget.
+    Pipelined crash-tolerant transport: requests are SENT as soon as they
+    are submitted — many can be in flight at once — and a dedicated
+    reader thread matches ``("rsp", seq, reply)`` frames back to their
+    waiters by the PR-2 idempotency token, replacing the old send→recv
+    lockstep (one RPC round trip per request, serialized).  Every RPC
+    still carries a ``(client_id, seq)`` token; on any connection failure
+    the reader reconnects with exponential backoff + jitter
+    (``MXNET_KVSTORE_RETRY_*``) and REPLAYS every in-flight envelope
+    under its original token, which the server deduplicates — so retried
+    pushes after a dropped ACK are applied exactly once even with
+    multiple requests in flight, and a server kill+restart (snapshot
+    recovery) is ridden out transparently within the retry budget.
 
     Usable as a context manager; ``close()`` is idempotent and always
-    joins the heartbeat thread.
+    joins the heartbeat and reader threads.
     """
 
     def __init__(self, host, port):
@@ -545,11 +654,20 @@ class ServerClient:
         self._cid = uuid.uuid4().hex  # idempotency namespace for this client
         self._seq = 0
         self._sock = None
-        self._lock = threading.Lock()
         self._closed = False
         self._hb_stop = None
         self._hb_thread = None
+        # _state_cv guards _seq/_inflight/_closed; _send_lock serializes
+        # socket writes and reconnects.  Ordering rule: _send_lock may be
+        # taken first and _state_cv inside it, never the reverse.
+        self._state_cv = threading.Condition()
+        self._inflight: "OrderedDict[int, dict]" = OrderedDict()
+        self.max_inflight = 0
+        self._send_lock = threading.Lock()
         self._connect(_retry_conf())
+        self._reader = threading.Thread(target=self._reader_loop,
+                                        daemon=True, name="kvclient-reader")
+        self._reader.start()
 
     # -- transport ---------------------------------------------------------
     def _connect(self, conf):
@@ -557,8 +675,8 @@ class ServerClient:
         for attempt in range(conf["retries"] + 1):
             try:
                 faults.fire("kv.client.connect")
-                self._sock = socket.create_connection(self._addr,
-                                                      timeout=120)
+                self._sock = _nodelay(
+                    socket.create_connection(self._addr, timeout=120))
                 return
             except OSError as e:
                 last = e
@@ -570,45 +688,155 @@ class ServerClient:
             "kvstore server %s:%d unreachable after %d attempts: %s"
             % (self._addr[0], self._addr[1], conf["retries"] + 1, last))
 
-    def _drop_sock(self):
-        if self._sock is not None:
+    def _kill_sock_locked(self):
+        """Drop the socket (caller holds _send_lock).  shutdown() first:
+        close() alone does not reliably wake a reader parked in recv."""
+        sock, self._sock = self._sock, None
+        if sock is not None:
             try:
-                self._sock.close()
+                sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
-            self._sock = None
-
-    def _request(self, msg, retries=None):
-        """One idempotent round trip: send ``("req", cid, seq, msg)``,
-        reconnect+replay on connection failure.  Caller holds _lock."""
-        conf = _retry_conf()
-        if retries is not None:
-            conf = dict(conf, retries=retries)
-        self._seq += 1
-        envelope = ("req", self._cid, self._seq, msg)
-        last = None
-        for attempt in range(conf["retries"] + 1):
             try:
-                if self._sock is None:
-                    self._connect(conf)
-                _send_msg(self._sock, envelope, op="kv.client.send")
-                return _recv_msg(self._sock, op="kv.client.recv")
-            except (ConnectionError, OSError, EOFError) as e:
-                last = e
-                self._drop_sock()
-                if attempt >= conf["retries"]:
-                    break
-                _backoff_sleep(attempt, conf)
-        raise ConnectionError(
-            "kvstore rpc %r to %s:%d failed after %d attempts: %s"
-            % (msg[0], self._addr[0], self._addr[1],
-               conf["retries"] + 1, last))
+                sock.close()
+            except OSError:
+                pass
+
+    def _submit(self, msg, retries=None):
+        """Register an in-flight entry and send its envelope; returns the
+        entry whose ``event`` fires when the reply (or failure) lands.
+        Non-blocking beyond the socket write — the pipelining primitive."""
+        with self._state_cv:
+            if self._closed:
+                raise ConnectionError("ServerClient is closed")
+            self._seq += 1
+            seq = self._seq
+            ent = {"seq": seq, "env": ("req", self._cid, seq, msg),
+                   "event": threading.Event(), "reply": None, "exc": None,
+                   "retries": retries, "replays": 0}
+            self._inflight[seq] = ent
+            if len(self._inflight) > self.max_inflight:
+                self.max_inflight = len(self._inflight)
+            self._state_cv.notify_all()  # wake the reader
+        with self._send_lock:
+            if self._sock is not None:
+                try:
+                    _send_msg(self._sock, ent["env"], op="kv.client.send")
+                except (ConnectionError, OSError, EOFError):
+                    # reader notices the dead socket and replays everything
+                    self._kill_sock_locked()
+        return ent
+
+    def _reader_loop(self):
+        """Single reader: waits for work, receives reply frames, matches
+        them to in-flight entries by seq.  Any transport failure funnels
+        into _recover(), which reconnects and replays all live tokens."""
+        while True:
+            with self._state_cv:
+                while not self._inflight and not self._closed:
+                    self._state_cv.wait()
+                if self._closed:
+                    return
+                sock = self._sock
+            if sock is None:
+                self._recover(None)
+                continue
+            try:
+                reply = _recv_msg(sock, op="kv.client.recv")
+            except (ConnectionError, OSError, EOFError):
+                self._recover(sock)
+                continue
+            if isinstance(reply, tuple) and len(reply) == 3 \
+                    and reply[0] == "rsp":
+                with self._state_cv:
+                    ent = self._inflight.pop(reply[1], None)
+                if ent is not None:
+                    ent["reply"] = reply[2]
+                    ent["event"].set()
+                # an unknown seq is a duplicate response from a replay
+                # race (original + replay both answered): drop it
+
+    def _recover(self, failed):
+        """Reconnect after a transport failure and replay every in-flight
+        envelope in seq order under its original idempotency token.  The
+        server's dedup window turns replays of already-applied requests
+        into recorded-reply replays — exactly-once with >1 in flight."""
+        conf = _retry_conf()
+        with self._send_lock:
+            if failed is not None and self._sock is not None \
+                    and self._sock is not failed:
+                return  # socket already replaced
+            self._kill_sock_locked()
+            last = None
+            for attempt in range(conf["retries"] + 1):
+                with self._state_cv:
+                    if self._closed or not self._inflight:
+                        return
+                try:
+                    faults.fire("kv.client.connect")
+                    sock = _nodelay(
+                        socket.create_connection(self._addr, timeout=120))
+                except OSError as e:
+                    last = e
+                    if attempt < conf["retries"]:
+                        _backoff_sleep(attempt, conf)
+                    continue
+                with self._state_cv:
+                    ents = sorted(self._inflight.values(),
+                                  key=lambda e: e["seq"])
+                sent_all = True
+                for ent in ents:
+                    limit = ent["retries"] if ent["retries"] is not None \
+                        else conf["retries"]
+                    ent["replays"] += 1
+                    if ent["replays"] > limit:
+                        # e.g. stop_server(retries=1): once the server
+                        # acked and exited, burning the whole budget on a
+                        # dead address helps nobody
+                        self._fail_entry(ent, ConnectionError(
+                            "kvstore rpc %r to %s:%d failed after %d "
+                            "attempts" % (ent["env"][3][0], self._addr[0],
+                                          self._addr[1], limit + 1)))
+                        continue
+                    try:
+                        _send_msg(sock, ent["env"], op="kv.client.send")
+                    except (ConnectionError, OSError, EOFError) as e:
+                        last = e
+                        sent_all = False
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        break
+                if not sent_all:
+                    if attempt < conf["retries"]:
+                        _backoff_sleep(attempt, conf)
+                    continue
+                self._sock = sock
+                return
+            # budget exhausted: fail every waiter
+            with self._state_cv:
+                ents = list(self._inflight.values())
+            for ent in ents:
+                self._fail_entry(ent, ConnectionError(
+                    "kvstore rpc %r to %s:%d failed after %d attempts: %s"
+                    % (ent["env"][3][0], self._addr[0], self._addr[1],
+                       conf["retries"] + 1, last)))
+
+    def _fail_entry(self, ent, exc):
+        with self._state_cv:
+            self._inflight.pop(ent["seq"], None)
+        ent["exc"] = exc
+        ent["event"].set()
 
     def _rpc(self, *msg, **kw):
         if self._closed:
             raise ConnectionError("ServerClient is closed")
-        with self._lock:
-            reply = self._request(msg, retries=kw.get("retries"))
+        ent = self._submit(msg, retries=kw.get("retries"))
+        ent["event"].wait()
+        if ent["exc"] is not None:
+            raise ent["exc"]
+        reply = ent["reply"]
         if reply[0] != "ok":
             from .base import MXNetError
 
@@ -637,7 +865,8 @@ class ServerClient:
             while not stop.wait(interval):
                 try:
                     if sock is None:
-                        sock = socket.create_connection(addr, timeout=30)
+                        sock = _nodelay(
+                            socket.create_connection(addr, timeout=30))
                     _send_msg(sock, ("heartbeat", rank))
                     reply = _recv_msg(sock)
                     if reply[0] != "ok":
@@ -677,6 +906,21 @@ class ServerClient:
     def pull(self, key):
         return self._rpc("pull", key)
 
+    def multi(self, msgs):
+        """One fused round trip over many inner commands (gradient
+        coalescing): the whole bucket rides a single idempotency token,
+        so crash-replay applies it exactly once.  Returns the inner
+        payloads in order; the first inner error raises."""
+        replies = self._rpc("multi", list(msgs))
+        out = []
+        for r in replies:
+            if r[0] != "ok":
+                from .base import MXNetError
+
+                raise MXNetError("kvstore server error: %s" % (r[1],))
+            out.append(r[1] if len(r) > 1 else None)
+        return out
+
     def set_optimizer(self, optimizer, is_recovery=False):
         self._rpc("set_optimizer",
                   pickle.dumps(optimizer, pickle.HIGHEST_PROTOCOL),
@@ -696,18 +940,30 @@ class ServerClient:
 
     # -- lifecycle ---------------------------------------------------------
     def close(self):
-        """Idempotent teardown: stop + join the heartbeat thread, close
-        the RPC socket.  Safe to call any number of times."""
-        if self._closed:
-            return
-        self._closed = True
+        """Idempotent teardown: stop + join the heartbeat and reader
+        threads, close the RPC socket, fail any remaining in-flight
+        waiters.  Safe to call any number of times."""
+        with self._state_cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._state_cv.notify_all()
         if self._hb_stop is not None:
             self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5)
             self._hb_thread = None
-        with self._lock:
-            self._drop_sock()
+        with self._send_lock:
+            self._kill_sock_locked()
+        reader = getattr(self, "_reader", None)
+        if reader is not None and reader is not threading.current_thread():
+            reader.join(timeout=5)
+        with self._state_cv:
+            ents = list(self._inflight.values())
+            self._inflight.clear()
+        for ent in ents:
+            ent["exc"] = ConnectionError("ServerClient is closed")
+            ent["event"].set()
 
     def __enter__(self):
         return self

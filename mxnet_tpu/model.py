@@ -57,15 +57,35 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
             kvstore.pull(idx, param_on_devs, priority=-idx)
 
 
-def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
-    """Centralized update: push grads, pull weights (reference model.py:88)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list is None or (isinstance(grad_list, list) and
-                                 grad_list[0] is None):
-            continue
-        kvstore.push(index, grad_list, priority=-index)
-        kvstore.pull(index, arg_list, priority=-index)
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
+                              param_order=None, defer_wait=False):
+    """Centralized update: push grads, pull weights (reference model.py:88).
+
+    All pushes are issued FIRST, in ``param_order`` (backward order — the
+    order gradients become available), each with ``priority=-index`` so an
+    async kvstore services front-layer keys first; pulls follow in forward
+    order.  On an async store nothing here blocks — with ``defer_wait``
+    the caller overlaps communication with the next batch's host-side
+    prep and waits later (Module._wait_async_comm); otherwise a final
+    ``wait_all`` restores the synchronous contract.  On a plain kvstore
+    push/pull complete inline and ``wait`` is the no-op base method, so
+    behavior is unchanged."""
+    n = len(param_arrays)
+    if param_order is None:
+        param_order = range(n - 1, -1, -1)
+
+    def has_grad(index):
+        g = grad_arrays[index]
+        return not (g is None or (isinstance(g, list) and g[0] is None))
+
+    for index in param_order:
+        if has_grad(index):
+            kvstore.push(index, grad_arrays[index], priority=-index)
+    for index in range(n):
+        if has_grad(index):
+            kvstore.pull(index, param_arrays[index], priority=-index)
+    if not defer_wait:
+        kvstore.wait_all()
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
@@ -81,6 +101,9 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
         if kvstore:
             kvstore.push(index, grad_list, priority=-index)
             kvstore.pull(index, grad_list, priority=-index)
+            # async store: the pulled-back grads feed the local updater
+            # next — wait this key out before reading
+            kvstore.wait(index)
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
             updater(index * num_device + k, g, w)

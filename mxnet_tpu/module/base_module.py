@@ -180,50 +180,62 @@ class BaseModule:
         from .. import profiler as _prof
 
         # ------------------------------------------------------ training loop
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            with _prof.Frame("Module.fit:epoch%d" % epoch, "fit"):
-                for nbatch, data_batch in enumerate(train_data):
-                    if monitor is not None:
-                        monitor.tic()
-                    with _prof.Frame("Module.fit:step", "fit"):
-                        self.forward_backward(data_batch)
-                        self.update()
-                    self.update_metric(eval_metric, data_batch.label)
-                    if monitor is not None:
-                        monitor.toc_print()
-                    if batch_end_callback is not None:
-                        batch_end_params = BatchEndParam(
-                            epoch=epoch, nbatch=nbatch,
-                            eval_metric=eval_metric, locals=locals())
-                        for callback in _as_list(batch_end_callback):
-                            callback(batch_end_params)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                with _prof.Frame("Module.fit:epoch%d" % epoch, "fit"):
+                    for nbatch, data_batch in enumerate(train_data):
+                        if monitor is not None:
+                            monitor.tic()
+                        with _prof.Frame("Module.fit:step", "fit"):
+                            self.forward_backward(data_batch)
+                            self.update()
+                        # on an async kvstore update() leaves comms in
+                        # flight; metric update + the iterator's next-batch
+                        # prefetch run inside that window, and the next
+                        # forward() drains it
+                        self.update_metric(eval_metric, data_batch.label)
+                        if monitor is not None:
+                            monitor.toc_print()
+                        if batch_end_callback is not None:
+                            batch_end_params = BatchEndParam(
+                                epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals())
+                            for callback in _as_list(batch_end_callback):
+                                callback(batch_end_params)
 
-            # one epoch of training is finished
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+                # one epoch of training is finished
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                toc = time.time()
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
 
-            # sync aux params across devices
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
+                # sync aux params across devices
+                arg_params_, aux_params_ = self.get_params()
+                self.set_params(arg_params_, aux_params_)
 
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+                if epoch_end_callback is not None:
+                    for callback in _as_list(epoch_end_callback):
+                        callback(epoch, self.symbol, arg_params_, aux_params_)
 
-            # ----------------------------------------------------- evaluation
-            if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+                # ------------------------------------------------- evaluation
+                if eval_data:
+                    res = self.score(eval_data, validation_metric,
+                                     score_end_callback=eval_end_callback,
+                                     batch_end_callback=eval_batch_end_callback,
+                                     epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
 
-            train_data.reset()
+                train_data.reset()
+        finally:
+            # an abandoned epoch (exception, early stop) must not leave a
+            # prefetching iterator's worker threads parked on live queues
+            close = getattr(train_data, "close", None)
+            if callable(close):
+                close()
 
     # ------------------------------------------------------------------
     # symbol / params

@@ -228,6 +228,16 @@ class DataParallelExecutorGroup:
         if self._monitor_callback is not None:
             executor.set_monitor_callback(self._monitor_callback)
 
+    def backward_param_order(self):
+        """Parameter indices in the order their gradients become available
+        — last layer first.  ``param_names`` follows the symbol's
+        topological (forward) order, so the reverse approximates backward
+        completion order; the centralized update path issues kvstore
+        pushes in this order so late-layer gradients hit the wire while
+        early layers are conceptually still being produced (reference
+        kvstore priority scheduling, kvstore_dist.h + engine)."""
+        return list(range(len(self.param_names) - 1, -1, -1))
+
     def _replicate(self, x):
         """Place a process-local array as fully-replicated on the (possibly
         multi-process) mesh."""

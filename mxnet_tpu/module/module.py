@@ -306,6 +306,14 @@ class Module(BaseModule):
                     "the Module with dist_mesh=False to train process-local "
                     "replicas against the parameter server")
 
+        if kvstore and update_on_kvstore:
+            # centralized-update path: ride the async comm engine so
+            # push/pull overlap compute (MXNET_KVSTORE_ASYNC=0 restores
+            # the synchronous loop; no-op if already wrapped)
+            from ..comm_engine import maybe_async
+
+            kvstore = maybe_async(kvstore)
+
         batch_size = self._exec_group.batch_size
         if self._exec_group._multiprocess:
             # gradients are summed over the GLOBAL batch by the compiled
@@ -410,11 +418,24 @@ class Module(BaseModule):
         self.optimizer_initialized = True
 
     # ------------------------------------------------------------------
+    def _wait_async_comm(self):
+        """Drain deferred kvstore traffic before parameters are read.
+        update() leaves pushes/pulls in flight on an async kvstore so
+        they overlap the next batch's host-side prep; the executor reads
+        raw param buffers (no NDArray read guard fires), so the overlap
+        window closes here."""
+        kv = getattr(self, "_kvstore", None)
+        if kv is not None and getattr(self, "_update_on_kvstore", False):
+            wait_all = getattr(kv, "wait_all", None)
+            if wait_all is not None:
+                wait_all()
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         # run any deferred fused batch first so its grads/outputs are not
         # interleaved with (or clobbered by) this forward
         self._flush_fused_pending()
+        self._wait_async_comm()
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
@@ -431,6 +452,9 @@ class Module(BaseModule):
         if self._fused_ok and self.optimizer_initialized:
             self._fused_pending = data_batch
             return
+        # this path does NOT go through self.forward(), so the async
+        # overlap window from the previous update() closes here
+        self._wait_async_comm()
         self._exec_group.forward_backward(data_batch)
 
     def _flush_fused_pending(self):
@@ -453,9 +477,16 @@ class Module(BaseModule):
             self._exec_group.fused_step(batch, self._optimizer, self._updater)
             return
         if self._update_on_kvstore:
-            _update_params_on_kvstore(self._exec_group.param_arrays,
-                                      self._exec_group.grad_arrays,
-                                      self._kvstore)
+            # pushes go out in backward order (the order grads become
+            # available) with priority=-index; the wait is deferred so an
+            # async kvstore overlaps comms with metric/update + the next
+            # batch fetch — forward() closes the window
+            _update_params_on_kvstore(
+                self._exec_group.param_arrays,
+                self._exec_group.grad_arrays,
+                self._kvstore,
+                param_order=self._exec_group.backward_param_order(),
+                defer_wait=True)
         else:
             # on a multi-process mesh the gradients coming out of the
             # executor are already globally summed (the psum is compiled
@@ -485,6 +516,7 @@ class Module(BaseModule):
 
     # ------------------------------------------------------------------
     def _sync_params_from_devices(self):
+        self._wait_async_comm()
         self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 

@@ -64,6 +64,12 @@ def _as_jax(x, ctx=None, dtype=None):
     return data
 
 
+# Hook installed by comm_engine: called with the NDArray before any host
+# read so an in-flight async kvstore pull targeting it completes first
+# (the reference engine's WaitToRead dependency, threaded_engine.h).
+_async_read_guard = None
+
+
 class NDArray:
     """n-dim array on a device context (reference: include/mxnet/ndarray.h)."""
 
@@ -111,13 +117,24 @@ class NDArray:
 
     # -- sync / host transfer ---------------------------------------------
     def wait_to_read(self):
-        """Block until the async value is materialised (ndarray.h:153-160)."""
+        """Block until the async value is materialised (ndarray.h:153-160).
+        When an async kvstore pull targets this array, also block until that
+        pull lands (the engine's WaitToRead contract, comm_engine.py)."""
+        g = _async_read_guard
+        if g is not None:
+            g(self)
         self._data.block_until_ready()
 
     def wait_to_write(self):
+        g = _async_read_guard
+        if g is not None:
+            g(self)
         self._data.block_until_ready()
 
     def asnumpy(self) -> np.ndarray:
+        g = _async_read_guard
+        if g is not None:
+            g(self)
         x = self._data
         # multi-process (global-mesh) arrays: a fully-replicated array has a
         # complete local copy on every process — read that; a sharded global

@@ -1,0 +1,362 @@
+"""Async dependency-scheduled kvstore comms (comm_engine.py): engine
+ordering contracts, implicit read completion, gradient bucketing, fp16
+wire compression, and the pipelined client's exactly-once guarantee
+(reference analogue: the ThreadedEngine Push/WaitForVar/WaitToRead
+contract scoped to kvstore traffic)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, nd
+from mxnet_tpu import kvstore_server as kvs
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.comm_engine import (AsyncKVStore, CommEngine, make_async,
+                                   maybe_async)
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------------------
+# CommEngine: dependency tracking + priority
+# ---------------------------------------------------------------------------
+def test_engine_priority_ordering():
+    """Among READY ops the highest priority runs first (Module pushes
+    front layers with the highest priority so their pulls land first)."""
+    eng = CommEngine(num_threads=1)
+    try:
+        order = []
+        gate = threading.Event()
+        eng.submit(lambda: gate.wait(5), ["gate"])  # parks the one worker
+        eng.submit(lambda: order.append("low"), ["a"], priority=-5)
+        eng.submit(lambda: order.append("mid"), ["b"], priority=0)
+        eng.submit(lambda: order.append("high"), ["c"], priority=9)
+        gate.set()
+        eng.wait_all()
+        assert order == ["high", "mid", "low"]
+    finally:
+        eng.shutdown()
+
+
+def test_engine_per_key_fifo_beats_priority():
+    """Ops on ONE key run in submission order no matter the priorities:
+    a later high-priority push must not overtake an earlier one."""
+    eng = CommEngine(num_threads=4)
+    try:
+        order = []
+        for i in range(30):
+            eng.submit(lambda i=i: order.append(i), ["k"], priority=i % 7)
+        eng.wait_all()
+        assert order == list(range(30))
+    finally:
+        eng.shutdown()
+
+
+def test_engine_wait_scoped_to_keys():
+    eng = CommEngine(num_threads=2)
+    try:
+        gate = threading.Event()
+        done = []
+        eng.submit(lambda: (gate.wait(5), done.append("slow")), ["s"])
+        eng.submit(lambda: done.append("fast"), ["f"])
+        eng.wait(["f"])  # must NOT require the parked op to finish
+        assert "fast" in done
+        gate.set()
+        eng.wait_all()
+        assert done == ["fast", "slow"]
+    finally:
+        eng.shutdown()
+
+
+def test_engine_failure_raises_at_barrier_then_recovers():
+    eng = CommEngine(num_threads=2)
+    try:
+        def boom():
+            raise ValueError("kaput")
+        eng.submit(boom, ["x"], label="comm.test")
+        with pytest.raises(MXNetError, match="kaput"):
+            eng.wait_all()
+        eng.submit(lambda: None, ["x"])
+        eng.wait_all()  # engine stays usable after a surfaced failure
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# AsyncKVStore: implicit completion + env gate
+# ---------------------------------------------------------------------------
+def test_read_guard_resolves_pending_pull(monkeypatch):
+    """Reading a pulled-into NDArray blocks until the pull lands (the
+    WaitToRead contract) — no explicit kv.wait() needed."""
+    kv = make_async(mx.kv.create("local"), num_threads=2, bucket_bytes=0)
+    try:
+        kv.init(3, nd.ones((4,)) * 5)
+        inner, orig = kv.inner, kv.inner.pull
+
+        def slow_pull(*a, **kw):
+            time.sleep(0.2)  # guarantees the read happens mid-flight
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(inner, "pull", slow_pull)
+        out = nd.zeros((4,))
+        kv.pull(3, out)
+        assert_almost_equal(out, np.full(4, 5.0))  # asnumpy -> guard
+        stats = kv.comm_stats()
+        assert stats["pulls"] == 1
+        assert stats["wait_calls"] >= 1
+    finally:
+        kv.close()
+
+
+def test_maybe_async_env_gate(monkeypatch):
+    kv = mx.kv.create("local")
+    monkeypatch.setenv("MXNET_KVSTORE_ASYNC", "0")
+    assert maybe_async(kv) is kv
+    monkeypatch.setenv("MXNET_KVSTORE_ASYNC", "1")
+    wrapped = maybe_async(kv)
+    try:
+        assert isinstance(wrapped, AsyncKVStore)
+        assert maybe_async(wrapped) is wrapped  # idempotent
+        assert maybe_async(None) is None
+    finally:
+        wrapped.close()
+
+
+# ---------------------------------------------------------------------------
+# async vs sync training: bit-identical weights
+# ---------------------------------------------------------------------------
+def _mlp(k=3):
+    from mxnet_tpu import sym
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, num_hidden=k, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _train_weights(monkeypatch, async_on):
+    monkeypatch.setenv("MXNET_KVSTORE_ASYNC", "1" if async_on else "0")
+    rng = np.random.RandomState(11)
+    X = rng.randn(120, 10).astype(np.float32)
+    y = (rng.randn(120) > 0).astype(np.float32)
+    mx.random.seed(7)  # identical Xavier draws across the two runs
+    train = mx.io.NDArrayIter(X, y, batch_size=30, shuffle=False)
+    mod = mx.mod.Module(_mlp(2), label_names=("softmax_label",))
+    # a KVStore INSTANCE keeps update_on_kvstore=True, so the update
+    # path really goes push -> server updater -> pull
+    mod.fit(train, num_epoch=3, kvstore=mx.kv.create("local"),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier())
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in sorted(args.items())}
+
+
+def test_async_training_bit_identical_to_sync(monkeypatch):
+    """The engine only reorders INDEPENDENT keys; per-key FIFO plus the
+    forward() barrier make the async schedule numerically invisible."""
+    sync_w = _train_weights(monkeypatch, async_on=False)
+    async_w = _train_weights(monkeypatch, async_on=True)
+    assert sync_w.keys() == async_w.keys()
+    for name in sync_w:
+        assert np.array_equal(sync_w[name], async_w[name]), \
+            "weights diverged for %s" % name
+
+
+def test_module_backward_param_order():
+    mod = mx.mod.Module(_mlp(), label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    n = len(mod._exec_group.param_names)
+    assert mod._exec_group.backward_param_order() == \
+        list(range(n - 1, -1, -1))
+
+
+# ---------------------------------------------------------------------------
+# gradient bucketing over dist_async
+# ---------------------------------------------------------------------------
+def test_bucketed_push_pull_values_and_metrics():
+    kv = make_async(mx.kv.create("dist_async"), num_threads=4,
+                    bucket_bytes=1 << 16)
+    try:
+        n = 40
+        for i in range(n):
+            kv.init(i, nd.zeros((8,)))
+        for i in range(n):
+            kv.push(i, nd.array(np.full(8, float(i), np.float32)))
+        outs = [nd.zeros((8,)) for _ in range(n)]
+        for i in range(n):
+            kv.pull(i, outs[i])
+        kv.wait_all()
+        for i in range(n):
+            assert_almost_equal(outs[i], np.full(8, float(i)))
+        stats = kv.comm_stats()
+        assert stats["pushes"] == n and stats["pulls"] == n
+        assert stats["bucket_flushes"] >= 2  # >=1 push + >=1 pull bucket
+        assert stats["bucket_keys"] >= 2 * n - 2
+        assert 0.0 < stats["bucket_fill_ratio"] <= 1.0
+        assert stats["bytes_pushed"] == n * 8 * 4
+        assert stats["bytes_pulled"] == n * 8 * 4
+        assert stats["queue_depth"] == 0
+        assert stats["inflight_peak"] >= 1
+    finally:
+        kv.close()
+
+
+def test_bucket_cross_op_same_key_ordering():
+    """push(k); pull(k) with both buffered: the pull must observe the
+    push (opposing buffer flushes keep per-key program order)."""
+    kv = make_async(mx.kv.create("dist_async"), num_threads=4,
+                    bucket_bytes=1 << 20)  # nothing flushes on bytes
+    try:
+        kv.init(0, nd.zeros((4,)))
+        out = nd.zeros((4,))
+        kv.push(0, nd.ones((4,)) * 3)
+        kv.pull(0, out)
+        kv.wait_all()
+        assert_almost_equal(out, np.full(4, 3.0))
+    finally:
+        kv.close()
+
+
+def test_push_multi_pull_multi_direct():
+    kv = mx.kv.create("dist_async")
+    try:
+        shapes = [(3,), (2, 4), (5,)]
+        for i, s in enumerate(shapes):
+            kv.init(i, nd.zeros(s))
+        kv.push_multi(
+            [(i, [nd.array(np.full(s, i + 1.0, np.float32))])
+             for i, s in enumerate(shapes)])
+        outs = [nd.zeros(s) for s in shapes]
+        kv.pull_multi([(i, [outs[i]]) for i in range(len(shapes))])
+        for i, s in enumerate(shapes):
+            assert_almost_equal(outs[i], np.full(s, i + 1.0))
+    finally:
+        kv.close()
+
+
+def test_dist_push_merges_multi_device_values_on_device():
+    """One push of a list of per-device grads transfers ONE merged array
+    (the old path round-tripped every value through asnumpy first)."""
+    kv = mx.kv.create("dist_async")
+    try:
+        kv.init(1, nd.zeros((4, 4)))
+        kv.push(1, [nd.ones((4, 4)), nd.ones((4, 4)) * 2])
+        out = nd.zeros((4, 4))
+        kv.pull(1, out)
+        assert_almost_equal(out, np.full((4, 4), 3.0))
+    finally:
+        kv.close()
+
+
+def test_fp16_compression_error_feedback(monkeypatch):
+    """fp16-on-the-wire with per-key error feedback: the second push
+    carries the first push's quantization residual, bit-exactly."""
+    monkeypatch.setenv("MXNET_KVSTORE_COMPRESS", "fp16")
+    kv = mx.kv.create("dist_async")
+    try:
+        rng = np.random.RandomState(3)
+        v1 = rng.randn(64).astype(np.float32)
+        v2 = rng.randn(64).astype(np.float32)
+        kv.init(9, nd.zeros((64,)))
+        kv.push(9, nd.array(v1))
+        kv.push(9, nd.array(v2))
+        out = nd.zeros((64,))
+        kv.pull(9, out)
+        s1 = v1.astype(np.float16)
+        r1 = v1 - s1.astype(np.float32)
+        s2 = (v2 + r1).astype(np.float16)
+        # no updater: the server accumulates the decompressed pushes
+        expect = s1.astype(np.float32) + s2.astype(np.float32)
+        assert np.array_equal(out.asnumpy(), expect)
+    finally:
+        kv.close()
+
+
+# ---------------------------------------------------------------------------
+# pipelined transport under fault injection
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_pipelined_client_two_inflight_exactly_once(monkeypatch):
+    """TWO pushes in flight when an ACK is dropped: the reconnect replays
+    both envelopes under their original tokens and the server applies
+    each exactly once."""
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "40")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "20")
+    srv = kvs.start_server(num_workers=1)
+    host, port = srv.addr
+    try:
+        # recv #1 is the init ACK; #2 is the first push ACK — dropped
+        # after the server already applied it, with push #2 also in flight
+        with faults.inject("kv.client.recv:drop=1@#2"):
+            with kvs.ServerClient(host, port) as c:
+                c.init(0, np.zeros(4, np.float32))
+                e1 = c._submit(("push", 0, np.full(4, 5.0, np.float32), 0))
+                e2 = c._submit(("push", 0, np.full(4, 7.0, np.float32), 0))
+                assert e1["event"].wait(10) and e2["event"].wait(10)
+                assert e1["exc"] is None and e2["exc"] is None
+                out = c.pull(0)
+        np.testing.assert_array_equal(out, np.full(4, 12.0, np.float32))
+        assert srv.applied_pushes == 2  # the replay was deduplicated
+    finally:
+        srv.stop()
+
+
+@pytest.mark.chaos
+def test_bucketed_push_survives_socket_loss(monkeypatch):
+    """A whole bucket rides one idempotency token: socket loss mid-stream
+    replays the fused envelope and every inner push applies once."""
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "40")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "20")
+    with faults.inject("kv.client.recv:drop=1@#4"):
+        kv = make_async(mx.kv.create("dist_async"), num_threads=2,
+                        bucket_bytes=1 << 16)
+        try:
+            n = 20
+            for i in range(n):
+                kv.init(i, nd.zeros((8,)))
+            for i in range(n):
+                kv.push(i, nd.array(np.full(8, float(i), np.float32)))
+            outs = [nd.zeros((8,)) for _ in range(n)]
+            for i in range(n):
+                kv.pull(i, outs[i])
+            kv.wait_all()
+            for i in range(n):
+                assert_almost_equal(outs[i], np.full(8, float(i)))
+            assert kv.inner._server.applied_pushes == n
+        finally:
+            kv.close()
+
+
+# ---------------------------------------------------------------------------
+# PrefetchingIter lifecycle
+# ---------------------------------------------------------------------------
+def test_prefetching_iter_close_and_context_manager():
+    X = np.arange(24, dtype=np.float32).reshape(12, 2)
+    base = mx.io.NDArrayIter(X, np.zeros(12, np.float32), batch_size=4)
+    with mx.io.PrefetchingIter(base) as it:
+        assert len(list(it)) == 3
+    it.close()  # idempotent
+    it.reset()  # and restartable
+    batches = list(it)
+    assert len(batches) == 3
+    assert_almost_equal(batches[0].data[0], X[:4])
+    it.close()
+
+
+def test_fit_closes_prefetching_iter():
+    rng = np.random.RandomState(0)
+    X = rng.randn(60, 10).astype(np.float32)
+    y = (rng.randn(60) > 0).astype(np.float32)
+    base = mx.io.NDArrayIter(X, y, batch_size=20)
+    it = mx.io.PrefetchingIter(base)
+    mod = mx.mod.Module(_mlp(2), label_names=("softmax_label",))
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    assert it._exhausted  # fit's finally tore the workers down
