@@ -36,6 +36,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# bench runs always collect step telemetry (MFU/recompile/step-time
+# counters); explicit MXNET_TELEMETRY=0 in the environment still wins
+os.environ.setdefault("MXNET_TELEMETRY", "1")
+
 # BASELINE.md two-track targets of record (model-level transformer MFU)
 LM_ROUND_TARGET = 0.30
 LM_NORTH_STAR = 0.40
@@ -212,6 +216,20 @@ def _headline(record):
     return record
 
 
+def _telemetry_fields(record):
+    """Fold the telemetry summary into the record (never allowed to
+    break the bench)."""
+    try:
+        from mxnet_tpu import telemetry
+        if telemetry.enabled():
+            summ = telemetry.summary()
+            if summ:  # nothing ran — keep the record shape unchanged
+                record["telemetry"] = summ
+    except Exception as e:
+        print("telemetry summary failed: %r" % (e,), file=sys.stderr)
+    return record
+
+
 def main(argv=None):
     """Single-process bench (the pre-r5 behavior): ResNet first, then the
     flash kernel + transformer-LM secondaries. Used by tpu_checklist
@@ -239,6 +257,7 @@ def main(argv=None):
     # keep the resnet-shaped record (metric/value = img/s) — the
     # checklist summarizer scores this shape; only the orchestrated CLI
     # reshapes the headline via _headline()
+    _telemetry_fields(record)
     print(json.dumps(record))
     return record
 
@@ -274,6 +293,7 @@ def _phase(cli):
                 except Exception as e:
                     print("flash kernel secondary failed: %r" % (e,),
                           file=sys.stderr)
+    _telemetry_fields(record)
     print(json.dumps(record))
     return record
 

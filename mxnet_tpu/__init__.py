@@ -47,6 +47,10 @@ if ("JAX_COMPILATION_CACHE_DIR" not in _os.environ and _plats
 
 from . import base
 from .base import MXNetError
+# telemetry must land before the layers it instruments (callback, faults,
+# kvstore, comm_engine, module, io, serving) so their module-level lazy
+# handles resolve against a fully initialised registry
+from . import telemetry
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from .attribute import AttrScope
 from .name import NameManager, Prefix

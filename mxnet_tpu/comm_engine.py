@@ -67,40 +67,62 @@ register_env("MXNET_KVSTORE_BUCKET_BYTES", 65536, int,
 # metrics (the serving-style counter idiom, serving/metrics.py)
 # ---------------------------------------------------------------------------
 class CommMetrics:
-    """Comm-plane counters: one lock, plain ints/floats, ``snapshot()``
-    returns a consistent dict (mirrors serving.ServingMetrics)."""
+    """Comm-plane counters on the shared telemetry registry.
+
+    Storage is a per-store :class:`telemetry.Registry` (``mxtpu_comm_*``
+    series, registered as a collector so they appear in the global
+    Prometheus render); ``snapshot()`` keeps the original dict-returning
+    API as a view over it, so ``kv.comm_stats()`` callers see the same
+    keys as before."""
 
     _COUNTERS = ("pushes", "pulls", "bytes_pushed", "bytes_pulled",
                  "bucket_flushes", "bucket_keys", "wait_calls")
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._c = {k: 0 for k in self._COUNTERS}
-        self._c["wait_ms_total"] = 0.0
-        self._c["bucket_fill_ratio_sum"] = 0.0
+        from . import telemetry as _tm
+
+        self._reg = _tm.Registry()
+        self._c = {k: self._reg.counter("mxtpu_comm_%s" % k)
+                   for k in self._COUNTERS}
+        self._c["wait_ms_total"] = self._reg.counter(
+            "mxtpu_comm_wait_ms_total",
+            "Total time blocked in engine waits (ms).")
+        self._fill_sum = self._reg.counter(
+            "mxtpu_comm_bucket_fill_ratio_sum",
+            "Sum of per-flush bucket fill ratios (÷ flushes = avg fill).")
+        self._wait_hist = self._reg.histogram(
+            "mxtpu_comm_wait_ms_hist",
+            "Per-call engine wait time (ms).",
+            start=0.05, factor=4.0, count=10)
+        _tm.register_collector(self)
 
     def add(self, name, n=1):
-        with self._lock:
-            self._c[name] += n
+        self._c[name].inc(n)
 
     def note_bucket(self, nkeys, nbytes, capacity):
-        with self._lock:
-            self._c["bucket_flushes"] += 1
-            self._c["bucket_keys"] += nkeys
-            if capacity > 0:
-                self._c["bucket_fill_ratio_sum"] += \
-                    min(1.0, nbytes / float(capacity))
+        self._c["bucket_flushes"].inc()
+        self._c["bucket_keys"].inc(nkeys)
+        if capacity > 0:
+            self._fill_sum.inc(min(1.0, nbytes / float(capacity)))
 
     def note_wait(self, seconds):
-        with self._lock:
-            self._c["wait_calls"] += 1
-            self._c["wait_ms_total"] += seconds * 1e3
+        ms = seconds * 1e3
+        self._c["wait_calls"].inc()
+        self._c["wait_ms_total"].inc(ms)
+        self._wait_hist.observe(ms)
+
+    def add_live_gauge(self, name, fn, doc=""):
+        """Register a callback gauge (queue depth, inflight RPCs) read at
+        render/snapshot time."""
+        self._reg.gauge("mxtpu_comm_%s" % name, doc, fn=fn)
+
+    def render_prometheus(self):
+        return self._reg.render_prometheus()
 
     def snapshot(self):
-        with self._lock:
-            d = dict(self._c)
+        d = {k: c.value for k, c in self._c.items()}
         flushes = d["bucket_flushes"]
-        d["bucket_fill_ratio"] = (d.pop("bucket_fill_ratio_sum") / flushes
+        d["bucket_fill_ratio"] = (self._fill_sum.value / flushes
                                   if flushes else 0.0)
         d["avg_wait_ms"] = (d["wait_ms_total"] / d["wait_calls"]
                             if d["wait_calls"] else 0.0)
@@ -364,6 +386,27 @@ class AsyncKVStore(KVStore):
         self._pull_keys = set()
         self._pull_bytes = 0
         self.metrics = CommMetrics()
+        # live gauges: sampled at Prometheus-render/snapshot time
+        import weakref as _weakref
+
+        eng = self._engine
+        self.metrics.add_live_gauge(
+            "queue_depth", eng.outstanding,
+            "Ops queued or running in the comm engine.")
+        self.metrics.add_live_gauge(
+            "queue_peak", lambda e=eng: e.peak_outstanding,
+            "High-watermark of engine queue depth.")
+        _wself = _weakref.ref(self)
+
+        def _inflight():
+            s = _wself()
+            clients = getattr(s._kv, "_clients", None) if s else None
+            return sum(len(getattr(c, "_inflight", ()))
+                       for c in clients) if clients else 0
+
+        self.metrics.add_live_gauge(
+            "inflight_rpcs", _inflight,
+            "Pipelined RPCs awaiting replies across transport clients.")
         _install_read_guard()
 
     # -- identity ----------------------------------------------------------

@@ -482,6 +482,9 @@ class Executor:
             self._fused_introspect = (fn, jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
                 (diff_args, states, aux, other_args, rng, sc, opt_rng)))
+            # consumed by telemetry.StepMonitor (Module.update): one XLA
+            # cost analysis per new executable, never per step
+            self._fused_new_compile = True
         with _prof.Frame("Executor.fused_step", "exec"):
             outs, new_aux, new_params, new_states = fn(
                 diff_args, states, aux, other_args, rng, sc, opt_rng)

@@ -171,6 +171,17 @@ class FaultPlan:
                     fired.append((rule, n))
         return fired
 
+    @staticmethod
+    def _note_injected(op, kind, call_no):
+        """Telemetry counter + event per injected fault (no-op when off)."""
+        from .. import telemetry as _tm
+
+        if not _tm.enabled():
+            return
+        _tm.labeled_counter("mxtpu_faults_injected_total", "kind",
+                            "Faults injected by the active plan.").inc(kind)
+        _tm.log_event("fault_injected", op=op, fault=kind, call_no=call_no)
+
     def fire(self, op: str) -> None:
         """Evaluate all rules for one operation; may sleep, raise, or kill
         the process.  ``partial`` rules never fire here — they are polled
@@ -178,6 +189,7 @@ class FaultPlan:
         import time
 
         for rule, n in self._decide(op):
+            self._note_injected(op, rule.kind, n)
             if rule.kind == "delay":
                 time.sleep(rule.param if rule.param is not None else 0.01)
             elif rule.kind == "drop":
@@ -210,4 +222,7 @@ class FaultPlan:
                 if hit:
                     self.events.append((op, rule.kind, n))
                     frac = rule.param if rule.param is not None else 0.5
+                    hit_no = n
+        if frac is not None:
+            self._note_injected(op, "partial", hit_no)
         return frac

@@ -14,6 +14,7 @@ import gzip
 import os
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -254,6 +255,10 @@ class ResizeIter(DataIter):
 #: queue sentinel marking a source iterator's end of epoch
 _END_OF_EPOCH = object()
 
+#: telemetry instruments for the prefetch pipeline (created on first
+#: enabled use — see PrefetchingIter._prefetch_metrics)
+_PREFETCH_TELEM = None
+
 
 class PrefetchingIter(DataIter):
     """Producer/consumer prefetch over one or more source iterators, so host
@@ -389,10 +394,41 @@ class PrefetchingIter(DataIter):
         self._exhausted = False
         self._spin_up()
 
+    @staticmethod
+    def _prefetch_metrics():
+        """Lazy global-registry instruments shared by all prefetchers."""
+        global _PREFETCH_TELEM
+        if _PREFETCH_TELEM is None:
+            from . import telemetry as _tm
+
+            reg = _tm.registry()
+            _PREFETCH_TELEM = {
+                "starved_ms": reg.counter(
+                    "mxtpu_prefetch_starvation_ms_total",
+                    "Time the consumer blocked on empty prefetch queues."),
+                "occupancy": reg.histogram(
+                    "mxtpu_prefetch_queue_occupancy",
+                    "Prefetch queue fill observed at each batch pop.",
+                    start=1.0, factor=2.0, count=8),
+                "batches": reg.counter("mxtpu_prefetch_batches_total",
+                                       "Batches popped from the pipeline."),
+            }
+        return _PREFETCH_TELEM
+
     def iter_next(self):
         if self._exhausted:  # workers are gone; don't block on dead queues
             return False
-        parts = [q.get() for q in self._queues]
+        from . import telemetry as _tm
+
+        if _tm.enabled():
+            m = self._prefetch_metrics()
+            m["occupancy"].observe(sum(q.qsize() for q in self._queues))
+            t0 = time.monotonic()
+            parts = [q.get() for q in self._queues]
+            m["starved_ms"].inc((time.monotonic() - t0) * 1e3)
+        else:
+            m = None
+            parts = [q.get() for q in self._queues]
         for p in parts:
             if isinstance(p, Exception):
                 raise p
@@ -403,6 +439,8 @@ class PrefetchingIter(DataIter):
                     "prefetch sources ended at different batch counts")
             self._exhausted = True
             return False
+        if m is not None:  # the end-of-epoch pop is not a batch
+            m["batches"].inc()
         first = parts[0]
         if any(p.pad != first.pad for p in parts):
             raise RuntimeError("prefetch sources disagree on batch padding")

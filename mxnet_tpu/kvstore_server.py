@@ -46,6 +46,8 @@ import numpy as np
 from . import faults
 from . import ndarray as nd
 from . import optimizer as opt
+from . import profiler as _prof
+from . import telemetry as _telemetry
 from .base import register_env
 
 __all__ = ["KVStoreServer", "start_server", "ServerClient",
@@ -167,6 +169,33 @@ def _recv_msg(sock, op=None):
     payload = _recv_exact(sock, n)
     bufs = [_recv_exact(sock, ln) for ln in lens]
     return pickle.loads(payload, buffers=bufs)
+
+
+# -- telemetry instruments (global registry; created on first enabled use)
+_TELEM = None
+
+
+def _srv_metrics():
+    global _TELEM
+    if _TELEM is None:
+        reg = _telemetry.registry()
+        _TELEM = {
+            "rpc_ms": reg.histogram(
+                "mxtpu_kvsrv_rpc_ms",
+                "Server-side RPC dispatch latency (ms).",
+                start=0.05, factor=4.0, count=10),
+            "rpc_total": reg.labeled_counter(
+                "mxtpu_kvsrv_rpc_total", "cmd", "RPCs dispatched."),
+            "dedup": reg.counter(
+                "mxtpu_kvsrv_dedup_replays_total",
+                "Idempotency replays answered from the dedup window."),
+            "snap_ms": reg.gauge(
+                "mxtpu_kvsrv_snapshot_ms",
+                "Duration of the last durable snapshot (ms)."),
+            "snaps": reg.counter(
+                "mxtpu_kvsrv_snapshots_total", "Durable snapshots written."),
+        }
+    return _TELEM
 
 
 class KVStoreServer:
@@ -295,12 +324,14 @@ class KVStoreServer:
         many tokens in flight, so records live in a per-client window of
         completed seqs rather than a single newest-seq slot."""
         if cid is None:
-            return self._dispatch_safe(msg)
+            return self._dispatch_timed(msg)
         with self._dedup_cv:
             rec = self._dedup.setdefault(
                 cid, {"floor": 0, "window": OrderedDict()})
             ent = rec["window"].get(seq)
             if ent is not None:
+                if _telemetry.enabled():
+                    _srv_metrics()["dedup"].inc()
                 while not ent["done"]:
                     self._dedup_cv.wait(0.1)
                 return ent["reply"]
@@ -309,7 +340,7 @@ class KVStoreServer:
                         % (seq, rec["floor"], cid))
             ent = {"done": False, "reply": None}
             rec["window"][seq] = ent
-        reply = self._dispatch_safe(msg)
+        reply = self._dispatch_timed(msg)
         with self._dedup_cv:
             if rec["window"].get(seq) is ent:
                 ent["reply"] = reply
@@ -339,6 +370,21 @@ class KVStoreServer:
             return self._dispatch(msg)
         except Exception as e:  # keep serving; tell the client
             return ("err", "%s: %s" % (type(e).__name__, e))
+
+    def _dispatch_timed(self, msg):
+        """_dispatch_safe plus telemetry: RPC latency histogram, per-command
+        counter, and a span on the merged trace.  Off path: one bool read,
+        then straight dispatch."""
+        if not _telemetry.enabled():
+            return self._dispatch_safe(msg)
+        cmd = msg[0] if isinstance(msg, tuple) and msg else "?"
+        m = _srv_metrics()
+        t0 = time.perf_counter()
+        with _prof.Frame("kv.rpc.%s" % cmd, "kvserver"):
+            reply = self._dispatch_safe(msg)
+        m["rpc_ms"].observe((time.perf_counter() - t0) * 1e3)
+        m["rpc_total"].inc(cmd)
+        return reply
 
     # -- message dispatch --------------------------------------------------
     def _dispatch(self, msg):
@@ -516,6 +562,7 @@ class KVStoreServer:
             return None
         from .filesystem import atomic_write
 
+        snap_t0 = time.perf_counter()
         with self._lock:
             store = dict(self.store)
             merge = {k: [dict(rnd) for rnd in rounds]
@@ -544,6 +591,13 @@ class KVStoreServer:
         payload = pickle.dumps(state, pickle.HIGHEST_PROTOCOL)
         atomic_write(self.snapshot_path, lambda f: f.write(payload),
                      checksum=True, op="kvsnap.write")
+        if _telemetry.enabled():
+            ms = (time.perf_counter() - snap_t0) * 1e3
+            m = _srv_metrics()
+            m["snap_ms"].set(ms)
+            m["snaps"].inc()
+            _telemetry.log_event("kvsrv_snapshot", ms=round(ms, 3),
+                                 bytes=len(payload))
         return self.snapshot_path
 
     def _restore_snapshot(self):

@@ -184,8 +184,31 @@ class BaseModule:
             for epoch in range(begin_epoch, num_epoch):
                 tic = time.time()
                 eval_metric.reset()
+                from .. import telemetry as _telemetry
+
+                data_iter = iter(train_data)
+                nbatch = 0
                 with _prof.Frame("Module.fit:epoch%d" % epoch, "fit"):
-                    for nbatch, data_batch in enumerate(train_data):
+                    while True:
+                        # data-wait: time blocked on the iterator (the
+                        # prefetch pipeline's starvation signal) — measured
+                        # only when telemetry is on so the off path stays
+                        # the plain next() call
+                        if _telemetry.enabled():
+                            t_fetch = time.monotonic()
+                            try:
+                                data_batch = next(data_iter)
+                            except StopIteration:
+                                break
+                            mon = getattr(self, "_telemetry_monitor", None)
+                            if mon is not None:
+                                mon().note_data_wait(
+                                    time.monotonic() - t_fetch)
+                        else:
+                            try:
+                                data_batch = next(data_iter)
+                            except StopIteration:
+                                break
                         if monitor is not None:
                             monitor.tic()
                         with _prof.Frame("Module.fit:step", "fit"):
@@ -204,6 +227,7 @@ class BaseModule:
                                 eval_metric=eval_metric, locals=locals())
                             for callback in _as_list(batch_end_callback):
                                 callback(batch_end_params)
+                        nbatch += 1
 
                 # one epoch of training is finished
                 for name, val in eval_metric.get_name_value():

@@ -15,6 +15,7 @@ import numpy as np
 from .. import context as ctx_mod
 from .. import ndarray as nd
 from .. import optimizer as opt
+from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..initializer import Uniform, InitDesc
 from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
@@ -81,6 +82,7 @@ class Module(BaseModule):
         self._label_shapes = None
         self._fused_ok = False
         self._fused_pending = None
+        self._tm_mon = None  # telemetry.StepMonitor, created when enabled
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -443,12 +445,25 @@ class Module(BaseModule):
         self._flush_fused_pending()
         self._exec_group.backward(out_grads=out_grads)
 
+    def _telemetry_monitor(self):
+        """Per-module StepMonitor, created on first use; callers must gate
+        on ``telemetry.enabled()`` so the off path allocates nothing."""
+        from .. import telemetry as _tm
+
+        if self._tm_mon is None:
+            self._tm_mon = _tm.StepMonitor(_tm)
+        return self._tm_mon
+
     def forward_backward(self, data_batch):
         """Fused forward+backward — one XLA program per batch.  When the
         fully-fused step is enabled, execution is deferred to update() so
         forward, backward, AND the optimizer run as a single donated XLA
         program (see _decide_fused)."""
         assert self.binded and self.params_initialized
+        if _telemetry.enabled():
+            mon = self._telemetry_monitor()
+            mon.step_begin()
+            mon.note_batch(data_batch)  # recompile fingerprint
         if self._fused_ok and self.optimizer_initialized:
             self._fused_pending = data_batch
             return
@@ -475,6 +490,8 @@ class Module(BaseModule):
         if self._fused_pending is not None:
             batch, self._fused_pending = self._fused_pending, None
             self._exec_group.fused_step(batch, self._optimizer, self._updater)
+            if _telemetry.enabled():
+                self._telemetry_step_end()
             return
         if self._update_on_kvstore:
             # pushes go out in backward order (the order grads become
@@ -499,6 +516,18 @@ class Module(BaseModule):
                            updater=self._updater,
                            num_device=1,
                            kvstore=kv)
+        if _telemetry.enabled():
+            self._telemetry_step_end()
+
+    def _telemetry_step_end(self):
+        """Close the step span: batch size, wall time, and — on the fused
+        path's compile misses — one XLA cost analysis for MFU."""
+        mon = self._telemetry_monitor()
+        ex = self._exec_group.execs[0] if self._exec_group.execs else None
+        if ex is not None and getattr(ex, "_fused_new_compile", False):
+            ex._fused_new_compile = False
+            mon.note_compile(ex)
+        mon.step_end(getattr(self._exec_group, "batch_size", 0))
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -22,7 +22,24 @@ __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "pause", "resume", "Frame"]
 
 _state = {"mode": "symbolic", "filename": "profile.json", "running": False,
-          "events": [], "jax_trace_dir": None, "lock": threading.Lock()}
+          "events": [], "tnames": {}, "jax_trace_dir": None,
+          "lock": threading.Lock()}
+
+# external span sink installed by mxnet_tpu.telemetry.tracer: when set,
+# Frame/record_event deliver each event (plus the recording thread's name)
+# there too, so telemetry captures spans without the profiler run state
+_sink = None
+
+
+def _set_sink(fn):
+    global _sink
+    _sink = fn
+
+
+def _snapshot_events():
+    """Consistent copy of (events, thread-name map) for trace mergers."""
+    with _state["lock"]:
+        return list(_state["events"]), dict(_state["tnames"])
 
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
@@ -42,8 +59,12 @@ def profiler_set_state(state="stop"):
     import jax
 
     if state == "run" and not _state["running"]:
-        _state["running"] = True
-        _state["events"] = []
+        # mutate under the lock: a Frame closing on another thread must
+        # never append into the buffer being replaced
+        with _state["lock"]:
+            _state["running"] = True
+            _state["events"] = []
+            _state["tnames"] = {}
         trace_dir = os.path.splitext(_state["filename"])[0] + "_xplane"
         try:
             jax.profiler.start_trace(trace_dir)
@@ -51,7 +72,8 @@ def profiler_set_state(state="stop"):
         except Exception:
             _state["jax_trace_dir"] = None
     elif state == "stop" and _state["running"]:
-        _state["running"] = False
+        with _state["lock"]:
+            _state["running"] = False
         if _state["jax_trace_dir"]:
             try:
                 jax.profiler.stop_trace()
@@ -60,11 +82,13 @@ def profiler_set_state(state="stop"):
 
 
 def pause():
-    _state["running"] = False
+    with _state["lock"]:
+        _state["running"] = False
 
 
 def resume():
-    _state["running"] = True
+    with _state["lock"]:
+        _state["running"] = True
 
 
 class Frame:
@@ -80,26 +104,43 @@ class Frame:
         return self
 
     def __exit__(self, *exc):
-        if _state["running"]:
+        sink = _sink
+        if _state["running"] or sink is not None:
             t1 = time.perf_counter_ns() // 1000
-            with _state["lock"]:
-                _state["events"].append({
-                    "name": self.name, "cat": self.category, "ph": "X",
-                    "ts": self._t0, "dur": t1 - self._t0, "pid": 0,
-                    "tid": threading.get_ident() % 100000})
+            # real thread id: spans from worker threads (comm engine,
+            # serving batcher, kvstore handlers) land on their own tracks
+            tid = threading.get_ident()
+            ev = {"name": self.name, "cat": self.category, "ph": "X",
+                  "ts": self._t0, "dur": t1 - self._t0, "pid": 0, "tid": tid}
+            tname = threading.current_thread().name
+            if _state["running"]:
+                with _state["lock"]:
+                    _state["events"].append(ev)
+                    _state["tnames"][tid] = tname
+            if sink is not None:
+                sink(ev, tname)
 
 
 def record_event(name, t0_us, dur_us, category="op"):
-    if _state["running"]:
-        with _state["lock"]:
-            _state["events"].append({"name": name, "cat": category, "ph": "X",
-                                     "ts": t0_us, "dur": dur_us, "pid": 0,
-                                     "tid": 0})
+    sink = _sink
+    if _state["running"] or sink is not None:
+        tid = threading.get_ident()
+        ev = {"name": name, "cat": category, "ph": "X", "ts": t0_us,
+              "dur": dur_us, "pid": 0, "tid": tid}
+        tname = threading.current_thread().name
+        if _state["running"]:
+            with _state["lock"]:
+                _state["events"].append(ev)
+                _state["tnames"][tid] = tname
+        if sink is not None:
+            sink(ev, tname)
 
 
 def dump_profile():
     """Write the chrome trace file (reference profiler.py:34 → DumpProfile,
-    profiler.h:88)."""
+    profiler.h:88).  Safe to call mid-run: pending events are flushed
+    under ``_state["lock"]`` whether or not ``profiler_set_state("stop")``
+    ever ran."""
     with _state["lock"]:
         payload = {"traceEvents": list(_state["events"]),
                    "displayTimeUnit": "ms"}

@@ -3,18 +3,27 @@
 The reference framework had no serving tier at all; this follows the
 conventions production model servers converged on (TF Serving / Triton):
 a small set of counters + histograms, exported in Prometheus text format,
-cheap enough to update on every request under a single lock.  Batches are
-additionally emitted as :class:`mxnet_tpu.profiler.Frame` spans, so a
+cheap enough to update on every request.  Batches are additionally emitted
+as :class:`mxnet_tpu.profiler.Frame` spans, so a
 ``profiler_set_state("run")`` / ``dump_profile()`` around serving traffic
 shows each flushed batch on the chrome-trace timeline next to the
 executor's own events.
+
+Storage lives on the shared :mod:`mxnet_tpu.telemetry` registry (one
+private :class:`~mxnet_tpu.telemetry.Registry` per server, registered as a
+collector so the series also appear in ``telemetry.render_prometheus()``);
+:meth:`render_text` keeps the original byte-exact Prometheus exposition —
+every pre-existing ``mxtpu_serving_*`` line renders unchanged.  The
+latency quantile reservoir and QPS sliding window are summary-type
+estimates with no registry analogue and stay local.
 """
 from __future__ import annotations
 
 import threading
 import time
 from collections import deque
-from typing import Dict
+
+from .. import telemetry as _telemetry
 
 __all__ = ["ServingMetrics"]
 
@@ -22,6 +31,10 @@ __all__ = ["ServingMetrics"]
 _QPS_WINDOW = 60.0
 # bounded reservoir of per-request latencies for the quantile estimates
 _LATENCY_SAMPLES = 4096
+
+_COUNTER_KEYS = ("requests_total", "requests_completed", "requests_rejected",
+                 "requests_expired", "requests_failed", "worker_crashes",
+                 "batches_total", "padded_items_total")
 
 
 def _percentile(sorted_vals, q):
@@ -47,61 +60,50 @@ class ServingMetrics:
     def __init__(self):
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
-        self.requests_total = 0
-        self.requests_rejected = 0
-        self.requests_expired = 0
-        self.requests_failed = 0
-        self.requests_completed = 0
-        self.worker_crashes = 0
-        self.batches_total = 0
-        self.padded_items_total = 0
-        self.queue_depth = 0
-        self.queue_depth_peak = 0
-        self.batch_size_hist: Dict[int, int] = {}
-        self.occupancy_hist: Dict[int, int] = {}
+        reg = self._registry = _telemetry.Registry()
+        self._c = {k: reg.counter("mxtpu_serving_%s" % k)
+                   for k in _COUNTER_KEYS}
+        self._g_depth = reg.gauge("mxtpu_serving_queue_depth")
+        self._g_peak = reg.gauge("mxtpu_serving_queue_depth_peak")
+        self._batch_hist = reg.labeled_counter("mxtpu_serving_batch_size",
+                                               "bucket")
+        self._occ_hist = reg.labeled_counter("mxtpu_serving_batch_occupancy",
+                                             "n")
         self._latencies = deque(maxlen=_LATENCY_SAMPLES)
         self._completions = deque()  # monotonic stamps inside _QPS_WINDOW
+        _telemetry.register_collector(self)
 
     # -- update hooks (called by the batcher/server) ----------------------
     def on_submit(self, queue_depth):
-        with self._lock:
-            self.requests_total += 1
-            self.queue_depth = queue_depth
-            self.queue_depth_peak = max(self.queue_depth_peak, queue_depth)
+        self._c["requests_total"].inc()
+        self._g_depth.set(queue_depth)
+        self._g_peak.set_max(queue_depth)
 
     def on_reject(self):
-        with self._lock:
-            self.requests_rejected += 1
+        self._c["requests_rejected"].inc()
 
     def on_expire(self, n=1):
-        with self._lock:
-            self.requests_expired += n
+        self._c["requests_expired"].inc(n)
 
     def on_fail(self, n=1):
-        with self._lock:
-            self.requests_failed += n
+        self._c["requests_failed"].inc(n)
 
     def on_worker_crash(self):
-        with self._lock:
-            self.worker_crashes += 1
+        self._c["worker_crashes"].inc()
 
     def on_dequeue(self, queue_depth):
-        with self._lock:
-            self.queue_depth = queue_depth
+        self._g_depth.set(queue_depth)
 
     def on_batch(self, bucket, occupancy):
-        with self._lock:
-            self.batches_total += 1
-            self.padded_items_total += bucket - occupancy
-            self.batch_size_hist[bucket] = \
-                self.batch_size_hist.get(bucket, 0) + 1
-            self.occupancy_hist[occupancy] = \
-                self.occupancy_hist.get(occupancy, 0) + 1
+        self._c["batches_total"].inc()
+        self._c["padded_items_total"].inc(bucket - occupancy)
+        self._batch_hist.inc(int(bucket))
+        self._occ_hist.inc(int(occupancy))
 
     def on_complete(self, latency_ms):
         now = time.monotonic()
+        self._c["requests_completed"].inc()
         with self._lock:
-            self.requests_completed += 1
             self._latencies.append(latency_ms)
             self._completions.append(now)
             cutoff = now - _QPS_WINDOW
@@ -123,32 +125,24 @@ class ServingMetrics:
         qps = self.qps()
         with self._lock:
             lat = sorted(self._latencies)
-            return {
-                "requests_total": self.requests_total,
-                "requests_completed": self.requests_completed,
-                "requests_rejected": self.requests_rejected,
-                "requests_expired": self.requests_expired,
-                "requests_failed": self.requests_failed,
-                "worker_crashes": self.worker_crashes,
-                "batches_total": self.batches_total,
-                "padded_items_total": self.padded_items_total,
-                "queue_depth": self.queue_depth,
-                "queue_depth_peak": self.queue_depth_peak,
-                "batch_size_hist": dict(self.batch_size_hist),
-                "occupancy_hist": dict(self.occupancy_hist),
-                "latency_ms_p50": _percentile(lat, 0.50),
-                "latency_ms_p99": _percentile(lat, 0.99),
-                "qps": qps,
-            }
+        out = {k: self._c[k].value for k in _COUNTER_KEYS}
+        out.update({
+            "queue_depth": self._g_depth.value,
+            "queue_depth_peak": self._g_peak.value,
+            "batch_size_hist": self._batch_hist.snapshot(),
+            "occupancy_hist": self._occ_hist.snapshot(),
+            "latency_ms_p50": _percentile(lat, 0.50),
+            "latency_ms_p99": _percentile(lat, 0.99),
+            "qps": qps,
+        })
+        return out
 
     def render_text(self):
-        """Prometheus text exposition of :meth:`snapshot`."""
+        """Prometheus text exposition of :meth:`snapshot` — byte-compatible
+        with the pre-registry renderer for every metric name."""
         s = self.snapshot()
         lines = []
-        for key in ("requests_total", "requests_completed",
-                    "requests_rejected", "requests_expired",
-                    "requests_failed", "worker_crashes", "batches_total",
-                    "padded_items_total"):
+        for key in _COUNTER_KEYS:
             lines.append("# TYPE mxtpu_serving_%s counter" % key)
             lines.append("mxtpu_serving_%s %d" % (key, s[key]))
         lines.append("# TYPE mxtpu_serving_queue_depth gauge")
@@ -170,3 +164,7 @@ class ServingMetrics:
         lines.append("# TYPE mxtpu_serving_qps gauge")
         lines.append("mxtpu_serving_qps %.3f" % s["qps"])
         return "\n".join(lines) + "\n"
+
+    def render_prometheus(self):
+        """Collector hook for ``telemetry.render_prometheus()``."""
+        return self.render_text()

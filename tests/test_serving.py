@@ -198,6 +198,11 @@ def test_metrics_text_output():
     try:
         srv.predict(data=np.zeros(IN_DIM, np.float32))
         text = srv.metrics_text()
+        # registry-backed: the server's per-instance registry is a live
+        # collector of the shared telemetry exposition
+        from mxnet_tpu import telemetry
+        assert "mxtpu_serving_requests_total 1" in \
+            telemetry.render_prometheus()
     finally:
         srv.stop()
     assert "mxtpu_serving_requests_total 1" in text

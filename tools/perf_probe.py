@@ -118,16 +118,16 @@ def main():
     fn, abstract = getattr(ex, "_fused_introspect", (None, None))
     report = {"batch_size": cli.batch_size, "compile_s": round(compile_s, 1)}
     if fn is not None and hasattr(fn, "lower"):
-        lowered = fn.lower(*abstract)
-        compiled = lowered.compile()
+        # same analysis path StepMonitor uses per compiled executable, so
+        # the probe's numbers and live telemetry MFU agree by construction
+        from mxnet_tpu import telemetry
         try:
-            ca = compiled.cost_analysis()
-            if isinstance(ca, list):
-                ca = ca[0]
-            report["xla_flops"] = ca.get("flops")
-            report["xla_bytes_accessed"] = ca.get("bytes accessed")
+            compiled, info = telemetry.lower_and_analyze(fn, abstract)
+            report["xla_flops"] = info.get("flops")
+            report["xla_bytes_accessed"] = info.get("bytes_accessed")
         except Exception as e:  # noqa
             report["cost_analysis_error"] = str(e)
+            compiled = fn.lower(*abstract).compile()
         hlo = compiled.as_text()
         ops = collections.Counter(
             re.findall(r"^\s*[%\w.-]+ = [\w\[\]<>{}, ]*?(\w+)\(", hlo,
@@ -164,9 +164,12 @@ def main():
     report["step_ms"] = round(1000 * dt / cli.num_steps, 2)
     report["img_per_sec"] = round(cli.batch_size * cli.num_steps / dt, 1)
     if report.get("xla_flops"):
-        # measured MFU from XLA's own flop count
+        # measured MFU from XLA's own flop count, same denominator as the
+        # live telemetry gauge (MXNET_TELEMETRY_PEAK_FLOPS-overridable)
+        from mxnet_tpu import telemetry
         report["mfu_xla_flops"] = round(
-            report["xla_flops"] / (dt / cli.num_steps) / 197e12, 4)
+            report["xla_flops"] / (dt / cli.num_steps)
+            / telemetry.peak_flops(), 4)
     print(json.dumps(report, indent=2))
 
 

@@ -1,0 +1,112 @@
+"""Inspect mxnet_tpu telemetry artifacts from the command line.
+
+Two subcommands::
+
+    python tools/telemetry_dump.py events run/events.jsonl [--tail 20]
+        Pretty-print a structured-event JSONL log (one event per line:
+        timestamp, kind, then the event's own fields).
+
+    python tools/telemetry_dump.py trace a.json b.json -o merged.json
+        Merge one or more Chrome-trace JSON files (dump_profile or
+        telemetry.dump_trace output) into a single timeline, schema-check
+        every event, and write the result — load it at chrome://tracing
+        or https://ui.perfetto.dev.
+
+Both read plain files: no framework import is needed for ``events``, so
+the tool works on logs copied off a TPU host.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_events(path):
+    events = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                print("%s:%d: unparseable line skipped" % (path, lineno),
+                      file=sys.stderr)
+    return events
+
+
+def cmd_events(cli):
+    events = _load_events(cli.file)
+    if cli.tail:
+        events = events[-cli.tail:]
+    if not events:
+        print("(no events)")
+        return 0
+    t0 = events[0].get("ts", 0.0)
+    for ev in events:
+        ts = ev.get("ts", 0.0)
+        kind = ev.get("kind", "?")
+        rest = {k: v for k, v in ev.items() if k not in ("ts", "kind")}
+        fields = " ".join("%s=%s" % (k, rest[k]) for k in sorted(rest))
+        print("+%9.3fs  %-16s %s" % (ts - t0, kind, fields))
+    print("-- %d event(s), %d kind(s)"
+          % (len(events), len({e.get("kind") for e in events})))
+    return 0
+
+
+def cmd_trace(cli):
+    from mxnet_tpu import telemetry
+
+    merged = []
+    for path in cli.files:
+        with open(path) as f:
+            payload = json.load(f)
+        evs = payload.get("traceEvents", payload) \
+            if isinstance(payload, dict) else payload
+        if not isinstance(evs, list):
+            print("%s: not a chrome-trace file" % path, file=sys.stderr)
+            return 1
+        merged.extend(evs)
+    # one metadata block wins per (pid, tid/name) — drop duplicates that
+    # appear when several dumps carry the same thread_name records
+    seen = set()
+    out = []
+    for ev in merged:
+        if ev.get("ph") == "M":
+            key = (ev.get("name"), ev.get("pid"), ev.get("tid"),
+                   json.dumps(ev.get("args", {}), sort_keys=True))
+            if key in seen:
+                continue
+            seen.add(key)
+        out.append(ev)
+    payload = {"traceEvents": out, "displayTimeUnit": "ms"}
+    telemetry.validate_trace(payload)
+    with open(cli.output, "w") as f:
+        json.dump(payload, f)
+    spans = sum(1 for e in out if e.get("ph") == "X")
+    tids = {(e.get("pid"), e.get("tid")) for e in out if e.get("ph") == "X"}
+    print("wrote %s: %d span(s) across %d thread track(s)"
+          % (cli.output, spans, len(tids)))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ev = sub.add_parser("events", help="pretty-print an events.jsonl log")
+    ev.add_argument("file")
+    ev.add_argument("--tail", type=int, default=0,
+                    help="only the last N events")
+    tr = sub.add_parser("trace",
+                        help="merge + validate chrome-trace JSON files")
+    tr.add_argument("files", nargs="+")
+    tr.add_argument("-o", "--output", required=True)
+    cli = ap.parse_args(argv)
+    return cmd_events(cli) if cli.cmd == "events" else cmd_trace(cli)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
