@@ -85,6 +85,11 @@ def _arg_parser():
                     help="omit the CPU-only kvstore transport phase")
     ap.add_argument("--kvstore-timeout", type=int, default=240,
                     help="seconds before the kvstore subprocess is killed")
+    ap.add_argument("--skip-shard-probe", action="store_true",
+                    help="omit the CPU-only GSPMD sharding smoke phase")
+    ap.add_argument("--shard-probe-timeout", type=int, default=600,
+                    help="seconds before the shard-probe subprocess is "
+                         "killed")
     return ap
 
 
@@ -373,6 +378,38 @@ def _kvstore_fields(timeout=240):
                                            "; ".join(tail[-2:])[:300])}
 
 
+def _shard_probe_fields(timeout=600):
+    """CPU-only GSPMD sharding smoke (tools/shard_probe.py) on a simulated
+    8-device mesh: megatron-ruled transformer LM fused step, reporting the
+    per-device vs replicated param bytes and the post-SPMD collective mix.
+    Needs no accelerator — the sharding subsystem stays continuously
+    exercised even when the TPU tunnel is down."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "shard_probe.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count=8"))
+    try:
+        proc = subprocess.run([sys.executable, script, "--smoke"],
+                              capture_output=True, text=True,
+                              timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return {"shard_probe_error":
+                "shard probe killed after %ds" % timeout}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        return {"shard_mesh": rec.get("mesh"),
+                "shard_params_bytes": rec.get("params_sharded_bytes"),
+                "shard_replicated_bytes": rec.get("params_replicated_bytes"),
+                "shard_collectives": rec.get("collectives")}
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return {"shard_probe_error": "rc=%d %s" % (proc.returncode,
+                                               "; ".join(tail[-2:])[:300])}
+
+
 def _probe_backend(timeout=300):
     """Claim and release the backend in a subprocess. Returns None when
     healthy, else a short error string."""
@@ -409,13 +446,16 @@ def orchestrate(argv=None):
         return {"metric": "transformer_lm_train_mfu", "value": 0.0,
                 "unit": "MFU", "vs_baseline": 0.0, "error": msg[:300]}
 
-    # CPU-only transport phase FIRST: it needs no accelerator, so its
-    # numbers survive every early return below (dead tunnel included)
+    # CPU-only phases FIRST: they need no accelerator, so their numbers
+    # survive every early return below (dead tunnel included)
     kv_fields = {} if cli.skip_kvstore else \
         _kvstore_fields(cli.kvstore_timeout)
+    shard_fields = {} if cli.skip_shard_probe else \
+        _shard_probe_fields(cli.shard_probe_timeout)
 
     def finish(rec):
         rec.update(kv_fields)
+        rec.update(shard_fields)
         print(json.dumps(rec))
         return rec
 

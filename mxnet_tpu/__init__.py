@@ -80,6 +80,7 @@ from . import kvstore as kv
 # (reference python/mxnet/kvstore_server.py:58 _init_kvstore_server_module)
 from . import kvstore_server
 from . import comm_engine
+from . import sharding
 from . import model
 from . import module
 from . import module as mod

@@ -22,13 +22,19 @@ from typing import Dict, Optional, Tuple
 
 from .base import MXNetError
 
-__all__ = ["save_sharded_checkpoint", "load_sharded_checkpoint"]
+__all__ = ["save_sharded_checkpoint", "load_sharded_checkpoint",
+           "load_partition_specs"]
 
 # written (by process 0) only after every process's shards have landed; a
 # directory without it is a crash-torn save.  Orbax's own commit marker
 # (commit_success.txt) is honored too, for checkpoints written before this
 # guard existed.
 _COMPLETE_MARKER = "mxnet_complete"
+
+# per-parameter PartitionSpec metadata saved next to the weights, so a
+# tensor-parallel layout restores onto a fresh mesh (same axis names)
+# without gathering anything to one host first
+_SPEC_FILE = "partition_specs.json"
 
 
 def _is_complete(path):
@@ -46,10 +52,48 @@ def _to_tree(arg_params, aux_params):
     return {"arg": unwrap(arg_params), "aux": unwrap(aux_params)}
 
 
-def save_sharded_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+def _spec_to_json(spec):
+    return [list(e) if isinstance(e, (tuple, list)) else
+            (None if e is None else str(e)) for e in tuple(spec)]
+
+
+def _spec_from_json(entries):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*[tuple(e) if isinstance(e, list) else e
+                           for e in entries])
+
+
+def _derive_specs(tree, overrides=None):
+    """{"arg"/"aux": {name: json-spec}} from the arrays' NamedShardings
+    (non-named / single-device shardings record as replicated)."""
+    from jax.sharding import NamedSharding
+
+    overrides = overrides or {}
+    out = {}
+    for grp, sub in tree.items():
+        g = {}
+        for name, x in sub.items():
+            if name in overrides:
+                g[name] = _spec_to_json(overrides[name])
+                continue
+            sharding = getattr(x, "sharding", None)
+            g[name] = _spec_to_json(sharding.spec) \
+                if isinstance(sharding, NamedSharding) else []
+        out[grp] = g
+    return out
+
+
+def save_sharded_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                            partition_specs=None):
     """Write ``prefix-symbol.json`` + ``prefix-<epoch>.orbax/`` (a sharded
     orbax tree).  In multi-process jobs every process must call this
-    collectively; each writes only its addressable shards."""
+    collectively; each writes only its addressable shards.
+
+    Each parameter's PartitionSpec (read off its NamedSharding, or from
+    ``partition_specs`` = {name: PartitionSpec} overrides) is saved as
+    ``partition_specs.json`` inside the directory, so the layout restores
+    onto a fresh mesh via ``load_sharded_checkpoint(..., mesh=...)``."""
     import jax
     import orbax.checkpoint as ocp
 
@@ -64,16 +108,41 @@ def save_sharded_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     if jax.process_index() == 0:
         from .filesystem import atomic_write
 
+        specs = _derive_specs(tree, partition_specs)
+        atomic_write(os.path.join(path, _SPEC_FILE),
+                     lambda f: f.write(
+                         json.dumps(specs, indent=1).encode()),
+                     op="ckpt.write")
+        # the spec file lands BEFORE the marker: a complete checkpoint
+        # always has its layout metadata
         atomic_write(os.path.join(path, _COMPLETE_MARKER),
                      lambda f: f.write(b"ok\n"), op="ckpt.write")
     return path
 
 
-def load_sharded_checkpoint(prefix, epoch, shardings=None):
+def load_partition_specs(prefix, epoch):
+    """{"arg"/"aux": {name: PartitionSpec}} saved with the checkpoint, or
+    None for checkpoints written before spec metadata existed."""
+    path = os.path.abspath("%s-%04d.orbax" % (prefix, epoch))
+    spec_path = os.path.join(path, _SPEC_FILE)
+    if not os.path.exists(spec_path):
+        return None
+    with open(spec_path) as f:
+        raw = json.load(f)
+    return {grp: {k: _spec_from_json(v) for k, v in sub.items()}
+            for grp, sub in raw.items()}
+
+
+def load_sharded_checkpoint(prefix, epoch, shardings=None, mesh=None):
     """-> (symbol_or_None, arg_params, aux_params) as NDArray dicts.
 
     ``shardings``: optional ``{"arg"/"aux": {name: jax.sharding}}`` tree to
     restore arrays directly onto a mesh (multi-host restore).
+
+    ``mesh``: rebuild the shardings from the checkpoint's own
+    ``partition_specs.json`` against this mesh — a tensor-parallel layout
+    restores shard-for-shard onto a fresh job (same axis names, possibly
+    different process topology) with no full-tensor gathers anywhere.
     """
     from . import ndarray as nd
     from . import symbol as sym
@@ -88,6 +157,28 @@ def load_sharded_checkpoint(prefix, epoch, shardings=None):
             "sharded checkpoint %s is incomplete (no completion marker): "
             "the saving job likely crashed mid-write — fall back to an "
             "earlier epoch" % path)
+    if mesh is not None and shardings is None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        saved = load_partition_specs(prefix, epoch)
+        if saved is None:
+            raise MXNetError(
+                "checkpoint %s has no partition-spec metadata; pass "
+                "explicit shardings= to restore onto a mesh" % path)
+        known = set(mesh.axis_names)
+        for grp, sub in saved.items():
+            for name, spec in sub.items():
+                used = {ax for e in tuple(spec) if e is not None
+                        for ax in (e if isinstance(e, tuple) else (e,))}
+                if not used <= known:
+                    raise MXNetError(
+                        "checkpoint spec for %s/%s uses mesh axes %s absent "
+                        "from the target mesh %s"
+                        % (grp, name, sorted(used - known),
+                           tuple(mesh.axis_names)))
+        shardings = {grp: {k: NamedSharding(mesh, spec)
+                           for k, spec in sub.items()}
+                     for grp, sub in saved.items()}
     ckpt = ocp.PyTreeCheckpointer()
     if shardings is not None:
         # pass shardings INTO orbax so each process reads only the shards

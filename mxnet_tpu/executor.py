@@ -224,6 +224,7 @@ class Executor:
         # shardings — tensor parallelism needs no graph changes here.
         self._shard_mesh = None
         self._shard_specs: Dict[str, Any] = {}
+        self._shard_fingerprint = None
 
     # ------------------------------------------------------------------
     def _as_nd(self, v):
@@ -347,12 +348,49 @@ class Executor:
                          for h, n in zip(holder, new))
         return new
 
-    def _get_fused_step(self, key, update_infos, pure_update, needs_rng):
+    def _fused_shardings(self, diff_args, states, aux, other_args):
+        """(in_shardings, out_shardings) pytrees for the fused step when a
+        mesh is active: every named array pins its PartitionSpec, optimizer
+        state leaves inherit their parameter's spec when like-shaped (else
+        replicate), and the rng/scalar slots stay unconstrained.  Lowering
+        the step under explicit shardings (rather than inferring from the
+        committed inputs alone) makes the SPMD layout part of the program
+        signature — reshard bugs fail at compile, not as silent copies."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = self._shard_mesh
+        rep = NamedSharding(mesh, PartitionSpec())
+
+        def ns(name):
+            return NamedSharding(mesh,
+                                 self._shard_specs.get(name, PartitionSpec()))
+
+        def state_ns(name, sub):
+            pshape = tuple(self.arg_dict[name].shape)
+
+            def leaf(x):
+                return ns(name) if tuple(x.shape) == pshape else rep
+
+            return jax.tree_util.tree_map(leaf, sub)
+
+        d = {k: ns(k) for k in diff_args}
+        s = {k: state_ns(k, sub) for k, sub in states.items()}
+        a = {k: ns(k) for k in aux}
+        o = {k: ns(k) for k in other_args}
+        in_s = (d, s, a, o, None, rep, None)
+        out_s = (None, a, d, s)
+        return in_s, out_s
+
+    def _get_fused_step(self, key, update_infos, pure_update, needs_rng,
+                        shardings=None):
         """Jitted forward+backward+update with donated param/state/aux
         buffers.  This is the whole of the reference's per-batch engine
         traffic (GraphExecutor::Forward/Backward + the kvstore push/pull +
         fused optimizer kernels, model.py:88-116) as ONE XLA program — no
-        host dispatch per parameter, buffers reused in place via donation."""
+        host dispatch per parameter, buffers reused in place via donation.
+        Under an active mesh, ``shardings`` = (in_shardings, out_shardings)
+        lowers the single program SPMD-partitioned."""
         import jax
         import jax.numpy as jnp
 
@@ -393,8 +431,14 @@ class Executor:
                     new_states[name] = s
                 return list(outs), new_aux, new_params, new_states
 
-            self._jit_cache[key] = fn if self._naive else \
-                jax.jit(fn, donate_argnums=(0, 1, 2))
+            if self._naive:
+                self._jit_cache[key] = fn
+            elif shardings is not None:
+                self._jit_cache[key] = jax.jit(
+                    fn, donate_argnums=(0, 1, 2),
+                    in_shardings=shardings[0], out_shardings=shardings[1])
+            else:
+                self._jit_cache[key] = jax.jit(fn, donate_argnums=(0, 1, 2))
         return self._jit_cache[key]
 
     def fused_step(self, optimizer, updater, param_names):
@@ -469,10 +513,15 @@ class Executor:
             k not in ("num_update", "begin_num_update", "lr", "wd")))
         key = ("fused", tuple(infos), id(optimizer), type(optimizer).__name__,
                hypers, float(optimizer.rescale_grad),
-               float(optimizer.clip_gradient or 0.0))
+               float(optimizer.clip_gradient or 0.0),
+               self._shard_fingerprint)
         first_build = key not in self._jit_cache
+        shardings = None
+        if self._shard_mesh is not None and not self._naive and first_build:
+            shardings = self._fused_shardings(diff_args, states, aux,
+                                              other_args)
         fn = self._get_fused_step(key, tuple(infos), optimizer.pure_update,
-                                  optimizer.needs_rng)
+                                  optimizer.needs_rng, shardings)
         if first_build and not self._naive:
             # introspection hook (compile-miss path only — zero per-step
             # cost): abstract arg signature of the fused call, so
@@ -517,13 +566,18 @@ class Executor:
         TPU-native replacement for the reference's multi-device executor
         split (graph_executor.cc device placement + kvstore comm); batch
         inputs fed later via ``forward(**kwargs)`` keep their spec."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec
+        from jax.sharding import PartitionSpec
 
         self._shard_mesh = mesh
         self._shard_specs = dict(arg_specs or {})
         if aux_specs:
             self._shard_specs.update(aux_specs)
+        # jit-cache discriminator: a later set_shardings with different
+        # specs must re-lower the fused step instead of reusing a program
+        # compiled for the old layout
+        self._shard_fingerprint = (
+            id(mesh), tuple(sorted((k, str(v))
+                                   for k, v in self._shard_specs.items())))
 
         known = set(self.arg_dict) | set(self.aux_dict) | set(self.grad_dict)
         unknown = sorted(set(self._shard_specs) - known)
@@ -532,11 +586,12 @@ class Executor:
                 "set_shardings: specs name no bound argument/aux: %s"
                 % unknown)
 
+        from .sharding import place as _place
+
         def put(arrs):
             for name, arr in arrs.items():
                 spec = self._shard_specs.get(name, PartitionSpec())
-                arr._set(jax.device_put(arr._data,
-                                        NamedSharding(mesh, spec)))
+                arr._set(_place(arr._data, mesh, spec))
 
         put(self.arg_dict)
         put(self.aux_dict)
@@ -554,14 +609,14 @@ class Executor:
             target[:] = value if not isinstance(value, np.ndarray) else \
                 nd.array(value, self._ctx)
             return
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec
+        from jax.sharding import PartitionSpec
+
+        from .sharding import place as _place
 
         v = value._data if isinstance(value, nd.NDArray) else \
             np.asarray(value, dtype=target.dtype)
         spec = self._shard_specs.get(name, PartitionSpec())
-        target._set(jax.device_put(
-            v, NamedSharding(self._shard_mesh, spec)))
+        target._set(_place(v, self._shard_mesh, spec))
 
     def forward(self, is_train: bool = False, **kwargs):
         from . import ndarray as nd

@@ -36,7 +36,7 @@ class DataParallelExecutorGroup:
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=logging, fixed_param_names=None,
                  grad_req="write", state_names=None, compute_dtype=None,
-                 dist_mesh=None):
+                 dist_mesh=None, mesh=None, partition_rules=None):
         self.symbol = symbol
         self.contexts = contexts
         self.compute_dtype = compute_dtype
@@ -72,9 +72,32 @@ class DataParallelExecutorGroup:
         self._data_sharding = None
         self._repl_sharding = None
         self._multiprocess = False
+        self._rules = None        # PartitionRules (GSPMD rule path)
+        self._param_specs = None  # resolved {name: PartitionSpec} at bind
+        self._data_axis = "data"
         import jax
 
-        if jax.process_count() > 1 and dist_mesh is not False:
+        if mesh is not None or partition_rules is not None:
+            # GSPMD rule path: an explicit named mesh (possibly multi-axis,
+            # e.g. ("data", "model")) + regex partition rules.  The batch
+            # shards on the LEADING axis; parameters follow their rule's
+            # PartitionSpec, resolved at bind once shapes are inferred.
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            from .. import sharding as _sharding
+
+            self._rules = _sharding.as_rules(
+                partition_rules if partition_rules is not None
+                else "replicated")
+            if not isinstance(mesh, Mesh):
+                mesh = _sharding.build_mesh(mesh if mesh is not None
+                                            else "data=-1")
+            self._mesh = mesh
+            self._data_axis = mesh.axis_names[0]
+            self._multiprocess = jax.process_count() > 1
+            self._data_sharding = NamedSharding(mesh, P(self._data_axis))
+            self._repl_sharding = NamedSharding(mesh, P())
+        elif jax.process_count() > 1 and dist_mesh is not False:
             # multi-host data parallelism: ONE global mesh over every device
             # of every process; the fused step compiles the gradient psum
             # over it (TPU-native replacement for the reference's
@@ -133,7 +156,17 @@ class DataParallelExecutorGroup:
                     "all data must have the same batch size"
             else:
                 self.batch_size = batch_size
-                if self._multiprocess:
+                if self._rules is not None:
+                    # explicit mesh: the batch splits over the leading
+                    # ('data') axis only — a ("data","model") 4x2 mesh
+                    # shards the batch 4 ways
+                    import jax
+
+                    n = int(self._mesh.shape[self._data_axis])
+                    if self._multiprocess:
+                        # per-process batch; each process feeds its shard
+                        n = max(1, n // jax.process_count())
+                elif self._multiprocess:
                     import jax
 
                     # per-process batch; each process feeds its local devices
@@ -142,8 +175,8 @@ class DataParallelExecutorGroup:
                     n = len(self.contexts)
                 if batch_size % n != 0:
                     raise MXNetError(
-                        "batch size %d is not divisible by the %d devices of "
-                        "the mesh" % (batch_size, n))
+                        "batch size %d is not divisible by the %d-way 'data' "
+                        "split of the mesh" % (batch_size, n))
                 step = batch_size // n
                 self.slices = [slice(i * step, (i + 1) * step)
                                for i in range(n)]
@@ -208,7 +241,12 @@ class DataParallelExecutorGroup:
                             compute_dtype=self.compute_dtype,
                             cast_exclude=self.label_names)
         self.execs = [executor]
-        if self._mesh is not None:
+        if self._rules is not None:
+            self._apply_rule_shardings(
+                executor,
+                {n: tuple(s) for n, s in zip(self.arg_names, arg_shapes)},
+                {n: tuple(s) for n, s in zip(self.aux_names, aux_shapes)})
+        elif self._mesh is not None:
             self._apply_shardings(executor)
 
         # parity views: param_arrays/grad_arrays are lists over "devices";
@@ -240,19 +278,64 @@ class DataParallelExecutorGroup:
 
     def _replicate(self, x):
         """Place a process-local array as fully-replicated on the (possibly
-        multi-process) mesh."""
-        import jax
+        multi-process) mesh.  Arrays already equivalently placed pass
+        through untouched — so ``set_params`` with pre-sharded arrays (a
+        checkpoint restored onto the mesh) is a placement no-op instead of
+        a spurious copy or a cross-process error."""
+        from ..sharding import place
 
-        if not self._multiprocess:
-            return jax.device_put(x, self._repl_sharding)
-        if getattr(x, "is_fully_addressable", True):
-            host = np.asarray(x)
-        elif getattr(x, "is_fully_replicated", False):
-            host = np.asarray(x.addressable_shards[0].data)
-        else:
-            raise MXNetError("cannot replicate a cross-process sharded array")
-        return jax.make_array_from_callback(
-            host.shape, self._repl_sharding, lambda idx: host[idx])
+        return place(x, self._mesh, self._repl_sharding.spec)
+
+    def _apply_rule_shardings(self, executor, arg_shapes, aux_shapes):
+        """Resolve the regex rules against the inferred shapes and hand the
+        whole layout to ``Executor.set_shardings``: batch inputs shard on
+        the leading mesh axis, every other arg/aux gets its rule's
+        PartitionSpec.  From here on every write path (set_params, batch
+        loads, the fused step's in_shardings) follows the same specs."""
+        from jax.sharding import PartitionSpec as P
+
+        from .. import sharding as _sharding
+        from ..base import env
+
+        batch_names = set(self.data_names) | set(self.label_names)
+        ruled = {name: shape
+                 for name, shape in list(arg_shapes.items())
+                 + list(aux_shapes.items()) if name not in batch_names}
+        specs = self._rules.match(ruled)
+        if env("MXNET_SHARDING_VALIDATE", 1, int):
+            _sharding.validate_specs(self._mesh, specs, ruled)
+        if env("MXNET_SHARDING_EXPLAIN", 0, int):
+            self.logger.info(
+                "partition rules (%s) on mesh %s:\n%s", self._rules.name,
+                _sharding.mesh_axes(self._mesh),
+                self._rules.explain_str(ruled))
+        self._param_specs = specs
+        all_specs = dict(specs)
+        for name in batch_names:
+            all_specs[name] = P(self._data_axis)
+        executor.set_shardings(self._mesh, all_specs)
+        self._note_shard_bytes(executor)
+
+    def _note_shard_bytes(self, executor):
+        """Telemetry gauge pair making a layout's memory win a number:
+        actual average per-device parameter residency vs the fully
+        replicated baseline."""
+        from .. import telemetry
+
+        if not telemetry.enabled():
+            return
+        from .. import sharding as _sharding
+
+        arrays = [executor.arg_dict[n] for n in self.param_names]
+        arrays += [executor.aux_dict[n] for n in self.aux_names]
+        per_dev, repl = _sharding.param_bytes(arrays)
+        telemetry.gauge(
+            "mxtpu_params_sharded_bytes",
+            "Average per-device parameter+aux bytes under the active "
+            "sharding").set(per_dev)
+        telemetry.gauge(
+            "mxtpu_params_replicated_bytes",
+            "Per-device parameter+aux bytes if fully replicated").set(repl)
 
     def _apply_shardings(self, executor):
         """Replicate params, shard batch inputs on the 'data' axis.  XLA's
@@ -297,6 +380,11 @@ class DataParallelExecutorGroup:
     def set_params(self, arg_params, aux_params):
         for executor in self.execs:
             executor.copy_params_from(arg_params, aux_params)
+        if self._rules is not None:
+            # copy_params_from routes through Executor._write_arg, which
+            # commits each value straight onto the mesh under its spec
+            # (pre-sharded arrays pass through) — nothing left to place
+            return
         if self._mesh is not None:
             self._apply_shardings(self.execs[0])
 
@@ -304,6 +392,19 @@ class DataParallelExecutorGroup:
         """Copy current params into the given dicts (reference
         executor_group.get_params — the weighted merge across devices is a
         no-op here: the mesh keeps one replicated copy)."""
+        if self._rules is not None:
+            # tensor-parallel layouts: gather shards to host values first
+            # (cross-process arrays are not directly indexable)
+            from .. import sharding as _sharding
+
+            executor = self.execs[0]
+            for name in self.param_names:
+                arg_params[name][:] = _sharding.gather_params(
+                    {name: executor.arg_dict[name]})[name]
+            for name in self.aux_names:
+                aux_params[name][:] = _sharding.gather_params(
+                    {name: executor.aux_dict[name]})[name]
+            return
         for name in self.param_names:
             arg_params[name][:] = self.execs[0].arg_dict[name]
         for name in self.aux_names:

@@ -221,8 +221,19 @@ class Module(BaseModule):
     # ------------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
-        """Bind executors (reference module.py:323)."""
+             grad_req="write", mesh=None, partition_rules=None):
+        """Bind executors (reference module.py:323).
+
+        ``mesh`` / ``partition_rules`` opt into GSPMD sharding: a named
+        device mesh (``jax.sharding.Mesh``, a ``sharding.MeshConfig``, or
+        the string form ``"data=-1,model=2"``) plus regex partition rules
+        (a ``sharding.PartitionRules``, a preset name, or a raw
+        ``[(regex, PartitionSpec), ...]`` list).  The batch shards on the
+        leading mesh axis; parameters follow their matching rule; the
+        fused train step lowers once under the resulting shardings.  With
+        neither given, ``MXNET_SHARDING_MESH`` / ``MXNET_SHARDING_RULES``
+        activate a layout from the environment; with nothing set the
+        replicated data-parallel path is unchanged."""
         if force_rebind:
             self._reset_bind()
         if self.binded:
@@ -244,13 +255,26 @@ class Module(BaseModule):
                 shared_module.binded and shared_module.params_initialized
             shared_group = shared_module._exec_group
 
+        if mesh is None and partition_rules is None:
+            from ..base import env
+
+            env_mesh = env("MXNET_SHARDING_MESH", "", str)
+            env_rules = env("MXNET_SHARDING_RULES", "", str)
+            if env_mesh:
+                mesh = env_mesh
+            if env_rules:
+                partition_rules = env_rules
+
         self._exec_group = DataParallelExecutorGroup(
             self._symbol, self._context, self._work_load_list,
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group, self.logger,
             self._fixed_param_names, grad_req, state_names=self._state_names,
-            compute_dtype=self._compute_dtype, dist_mesh=self._dist_mesh)
+            compute_dtype=self._compute_dtype, dist_mesh=self._dist_mesh,
+            mesh=mesh, partition_rules=partition_rules)
         self._total_exec_bytes = 0
+        if _telemetry.enabled() and self._exec_group._mesh is not None:
+            self._telemetry_monitor().note_mesh(self._exec_group._mesh)
 
         if shared_module is not None:
             self.params_initialized = True

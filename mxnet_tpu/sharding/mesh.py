@@ -1,0 +1,166 @@
+"""Named-mesh construction — the device-layout half of the GSPMD story.
+
+The executor stack expresses parallelism as data (`PartitionSpec`s over a
+named mesh), so the mesh itself must be easy to build correctly: axis sizes
+that multiply to the device count, one `-1` axis inferred from the rest,
+and a device ordering that keeps the leading (usually "data") axis
+contiguous per process so multi-host batches shard host-locally (the same
+layout contract `jax.make_array_from_process_local_data` expects).
+
+`build_mesh(("data", -1), ("model", 2))` on 8 devices -> a 4x2
+`Mesh(..., ("data", "model"))`; on a v5e-64 pod the same call gives 32x2
+without code changes — parallel layout is configuration, not code.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["MeshConfig", "build_mesh", "mesh_axes"]
+
+AxisSpec = Union[Tuple[str, int], Sequence]
+
+
+class MeshConfig:
+    """Declarative mesh layout: ordered (axis_name, size) pairs, at most one
+    size of ``-1`` (inferred so the product covers every device).
+
+    Accepts, for convenience at every call site (Module.bind kwargs, env
+    vars, CLI tools):
+
+    * ``MeshConfig(("data", -1), ("model", 2))``
+    * ``MeshConfig.parse("data=-1,model=2")``
+    * an existing ``jax.sharding.Mesh`` passes through :func:`build_mesh`.
+    """
+
+    def __init__(self, *axes: AxisSpec):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)) and axes[0] \
+                and isinstance(axes[0][0], (list, tuple)):
+            axes = tuple(axes[0])  # MeshConfig([("a", 1), ...]) form
+        if not axes:
+            raise MXNetError("MeshConfig needs at least one axis")
+        names = []
+        sizes = []
+        for ax in axes:
+            try:
+                name, size = ax
+            except (TypeError, ValueError):
+                raise MXNetError(
+                    "mesh axis must be a (name, size) pair, got %r" % (ax,))
+            name = str(name)
+            size = int(size)
+            if size == 0 or size < -1:
+                raise MXNetError(
+                    "mesh axis %r size must be positive or -1 (inferred), "
+                    "got %d" % (name, size))
+            if name in names:
+                raise MXNetError("duplicate mesh axis %r" % name)
+            names.append(name)
+            sizes.append(size)
+        if sizes.count(-1) > 1:
+            raise MXNetError(
+                "at most one mesh axis may have size -1 (inferred), got %s"
+                % list(zip(names, sizes)))
+        self.names: Tuple[str, ...] = tuple(names)
+        self.sizes: Tuple[int, ...] = tuple(sizes)
+
+    @classmethod
+    def parse(cls, text: str) -> "MeshConfig":
+        """``"data=-1,model=2"`` -> MeshConfig (the env-var / CLI syntax)."""
+        axes = []
+        for part in str(text).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise MXNetError(
+                    "mesh axis %r must be name=size (e.g. data=-1,model=2)"
+                    % part)
+            name, _, size = part.partition("=")
+            try:
+                axes.append((name.strip(), int(size)))
+            except ValueError:
+                raise MXNetError("mesh axis size %r is not an integer" % size)
+        return cls(*axes)
+
+    def resolve_sizes(self, num_devices: int) -> Tuple[int, ...]:
+        """Concrete per-axis sizes for ``num_devices`` (fills the -1)."""
+        fixed = 1
+        for s in self.sizes:
+            if s != -1:
+                fixed *= s
+        sizes = list(self.sizes)
+        if -1 in sizes:
+            if num_devices % fixed != 0:
+                raise MXNetError(
+                    "cannot infer mesh axis %r: %d devices not divisible by "
+                    "the fixed axes %s" % (
+                        self.names[sizes.index(-1)], num_devices,
+                        {n: s for n, s in zip(self.names, self.sizes)
+                         if s != -1}))
+            sizes[sizes.index(-1)] = num_devices // fixed
+        if int(np.prod(sizes)) != num_devices:
+            raise MXNetError(
+                "mesh %s covers %d devices but %d are available"
+                % (dict(zip(self.names, sizes)), int(np.prod(sizes)),
+                   num_devices))
+        return tuple(sizes)
+
+    def __repr__(self):
+        return "MeshConfig(%s)" % ", ".join(
+            "%s=%d" % (n, s) for n, s in zip(self.names, self.sizes))
+
+
+def _as_config(axes) -> MeshConfig:
+    if isinstance(axes, MeshConfig):
+        return axes
+    if isinstance(axes, str):
+        return MeshConfig.parse(axes)
+    if isinstance(axes, dict):
+        return MeshConfig(*axes.items())
+    return MeshConfig(*axes) if axes and isinstance(axes[0], (list, tuple)) \
+        else MeshConfig(axes)
+
+
+def build_mesh(axes="data=-1", devices=None):
+    """Create a ``jax.sharding.Mesh`` with named axes over ``devices``
+    (default: every device of every process).
+
+    ``axes``: MeshConfig | "data=-1,model=2" | ((name, size), ...) | dict.
+    Exactly one axis may be -1; its size is inferred.
+
+    Process-aware layout: devices keep their ``jax.devices()`` order
+    (grouped by process), and the LEADING axis must span whole processes —
+    so a ``("data", ..., "model")`` mesh keeps each host's devices in one
+    contiguous block of the data axis and model-axis collectives stay
+    intra-host (ICI, not DCN).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    cfg = _as_config(axes)
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices, dtype=object).reshape(-1)
+    sizes = cfg.resolve_sizes(devices.size)
+
+    nproc = jax.process_count()
+    if nproc > 1:
+        per_proc = devices.size // nproc
+        trailing = int(np.prod(sizes[1:])) if len(sizes) > 1 else 1
+        if trailing > per_proc or per_proc % trailing != 0:
+            raise MXNetError(
+                "mesh %s: the non-leading axes (%d-way) must divide the "
+                "per-process device count (%d) so the leading %r axis "
+                "spans whole processes" % (
+                    dict(zip(cfg.names, sizes)), trailing, per_proc,
+                    cfg.names[0]))
+    return Mesh(devices.reshape(sizes), cfg.names)
+
+
+def mesh_axes(mesh) -> Dict[str, int]:
+    """``{axis_name: size}`` for a Mesh (insertion-ordered)."""
+    return {name: int(mesh.shape[name]) for name in mesh.axis_names}

@@ -1,0 +1,199 @@
+"""Placing and collecting parameter dicts against a named mesh.
+
+``shard_params`` / ``gather_params`` are the SNIPPETS.md [3] helpers over
+this framework's name->NDArray dicts: place once (committed
+``NamedSharding``s, so every jitted step is partitioned from its inputs),
+collect without assuming single-host addressability, and account bytes so
+the memory win of a layout is a number (telemetry gauges, shard_probe),
+not a feeling.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["make_shardings", "shard_params", "gather_params",
+           "validate_specs", "spec_shard_factor", "param_bytes"]
+
+
+def _nd():
+    from .. import ndarray as nd
+
+    return nd
+
+
+def make_shardings(mesh, specs: Dict[str, object]) -> Dict[str, object]:
+    """{name: PartitionSpec} -> {name: NamedSharding} on ``mesh``."""
+    from jax.sharding import NamedSharding
+
+    return {name: NamedSharding(mesh, spec) for name, spec in specs.items()}
+
+
+def spec_shard_factor(mesh, spec) -> int:
+    """How many ways a spec splits an array (product of its mesh axis
+    sizes) — the per-device memory divisor."""
+    factor = 1
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for ax in axes:
+            factor *= int(mesh.shape[ax])
+    return factor
+
+
+def validate_specs(mesh, specs: Dict[str, object],
+                   shapes: Dict[str, Tuple[int, ...]]) -> None:
+    """Reject specs whose sharded dims don't divide evenly by their mesh
+    axes.  GSPMD would pad uneven shards silently; an uneven split of a
+    weight is almost always a mis-written rule, so fail loudly with the
+    parameter name (MXNET_SHARDING_VALIDATE=0 to allow padding)."""
+    problems = []
+    for name, spec in specs.items():
+        shape = tuple(shapes.get(name, ()))
+        for dim, entry in enumerate(tuple(spec)):
+            if entry is None or dim >= len(shape):
+                continue
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            factor = 1
+            for ax in axes:
+                if ax not in mesh.shape:
+                    problems.append("%s: spec axis %r is not a mesh axis %s"
+                                    % (name, ax, tuple(mesh.axis_names)))
+                    factor = 0
+                    break
+                factor *= int(mesh.shape[ax])
+            if factor and shape[dim] % factor != 0:
+                problems.append(
+                    "%s: dim %d (size %d) not divisible by the %d-way %r "
+                    "split" % (name, dim, shape[dim], factor, entry))
+    if problems:
+        raise MXNetError("invalid partition specs for mesh %s:\n  %s"
+                         % (dict((a, int(mesh.shape[a]))
+                                 for a in mesh.axis_names),
+                            "\n  ".join(problems)))
+
+
+def _already_placed(x, target) -> bool:
+    """True when ``x`` is a committed jax array whose sharding is already
+    equivalent to ``target`` — re-placement would be a pointless copy on a
+    single host and an ERROR for cross-process arrays (whose shards cannot
+    be rebuilt from one host's view)."""
+    sharding = getattr(x, "sharding", None)
+    if sharding is None or not getattr(x, "committed", True):
+        return False
+    try:
+        return sharding.is_equivalent_to(target, x.ndim)
+    except Exception:
+        return sharding == target
+
+
+def place(x, mesh, spec):
+    """Place one array (jax array / NDArray / numpy) onto the mesh under
+    ``spec``.  Already-correctly-placed arrays pass through untouched;
+    cross-process arrays that would need a true reshard raise (gather on
+    the caller first)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    nd = _nd()
+    if isinstance(x, nd.NDArray):
+        x = x._data
+    target = NamedSharding(mesh, spec)
+    if _already_placed(x, target):
+        return x
+    if not getattr(x, "is_fully_addressable", True):
+        if getattr(x, "is_fully_replicated", False):
+            x = np.asarray(x.addressable_shards[0].data)
+        else:
+            raise MXNetError(
+                "cannot re-place a cross-process sharded array (sharding %s "
+                "-> %s): gather it first or restore it directly onto the "
+                "target mesh" % (getattr(x, "sharding", None), target))
+    if jax.process_count() > 1:
+        host = np.asarray(x)
+        return jax.make_array_from_callback(host.shape, target,
+                                            lambda idx: host[idx])
+    return jax.device_put(x, target)
+
+
+def shard_params(params: Dict[str, object], mesh,
+                 specs: Optional[Dict[str, object]] = None,
+                 validate: bool = True) -> Dict[str, object]:
+    """Place a {name: NDArray} dict against ``mesh`` under ``specs``
+    ({name: PartitionSpec}; missing names replicate).  Returns a new dict
+    of NDArrays backed by committed mesh-placed arrays."""
+    from jax.sharding import PartitionSpec
+
+    nd = _nd()
+    specs = specs or {}
+    if validate:
+        validate_specs(mesh, {k: specs.get(k, PartitionSpec())
+                              for k in params},
+                       {k: tuple(getattr(v, "shape", ()))
+                        for k, v in params.items()})
+    out = {}
+    for name, arr in params.items():
+        placed = place(arr, mesh, specs.get(name, PartitionSpec()))
+        out[name] = arr if isinstance(arr, nd.NDArray) and \
+            placed is arr._data else nd.NDArray(placed)
+    return out
+
+
+def gather_params(params: Dict[str, object]) -> Dict[str, object]:
+    """Collect a (possibly sharded) {name: NDArray} dict to host numpy.
+
+    Single-host shards concatenate locally; cross-process arrays gather
+    through ``multihost_utils.process_allgather`` so every process gets
+    the full value (the explicit inverse of :func:`shard_params` — NOT on
+    any hot path)."""
+    nd = _nd()
+    out = {}
+    for name, arr in params.items():
+        x = arr._data if isinstance(arr, nd.NDArray) else arr
+        if getattr(x, "is_fully_addressable", True):
+            out[name] = np.asarray(x)
+        elif getattr(x, "is_fully_replicated", False):
+            out[name] = np.asarray(x.addressable_shards[0].data)
+        else:
+            from jax.experimental import multihost_utils
+
+            out[name] = np.asarray(multihost_utils.process_allgather(
+                x, tiled=True))
+    return out
+
+
+def param_bytes(arrays) -> Tuple[int, int]:
+    """(per_device_bytes, replicated_bytes) for an iterable of arrays.
+
+    ``replicated_bytes`` is what one device would hold if everything were
+    fully replicated (the pre-sharding layout); ``per_device_bytes`` is
+    the average actual residency per device under the current placement —
+    the telemetry gauge pair that makes a tensor-parallel memory win
+    visible in BENCH records."""
+    nd = _nd()
+    per_device = 0.0
+    replicated = 0
+    for arr in arrays:
+        if arr is None:
+            continue
+        x = arr._data if isinstance(arr, nd.NDArray) else arr
+        nbytes = int(np.prod(x.shape)) * x.dtype.itemsize
+        replicated += nbytes
+        sharding = getattr(x, "sharding", None)
+        ndev = len(sharding.device_set) if sharding is not None else 1
+        shards = getattr(x, "addressable_shards", None)
+        if shards and len(sharding.addressable_devices) == ndev:
+            per_device += sum(int(np.prod(s.data.shape))
+                              * s.data.dtype.itemsize
+                              for s in shards) / ndev
+        else:
+            # non-addressable (multi-host): derive from the spec instead
+            spec = getattr(sharding, "spec", None)
+            factor = spec_shard_factor(sharding.mesh, spec) \
+                if spec is not None else 1
+            per_device += nbytes / factor
+    return int(per_device), replicated

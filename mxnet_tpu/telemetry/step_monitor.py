@@ -138,7 +138,19 @@ class StepMonitor:
         self._mem_supported = True
         self._sigs = None  # recompile detector state: {sig}, last sig
         self._last_sig = None
+        self._mesh_axes = None  # {axis_name: size} when training on a mesh
         telemetry_mod._set_current_monitor(self)
+
+    def note_mesh(self, mesh):
+        """Record the device-mesh layout the module trains on (surfaces in
+        ``telemetry.summary()`` / BENCH records, next to the byte gauges,
+        so a run's parallel layout is part of its record)."""
+        if mesh is None:
+            self._mesh_axes = None
+            return
+        self._mesh_axes = {str(name): int(mesh.shape[name])
+                           for name in mesh.axis_names}
+        self._tm.log_event("mesh", axes=self._mesh_axes)
 
     # -- per-step hooks (Module.forward_backward / update / fit) ----------
     def note_data_wait(self, seconds):
@@ -263,6 +275,8 @@ class StepMonitor:
             "recompiles": self.c_recompiles.value,
             "device_peak_bytes": self.g_mem_peak.value or None,
         }
+        if self._mesh_axes:
+            rep["mesh"] = dict(self._mesh_axes)
         mfu = rep["mfu"]
         if mfu is not None:
             rep["mfu"] = round(mfu, 4)

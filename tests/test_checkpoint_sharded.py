@@ -75,3 +75,64 @@ def test_sharded_checkpoint_restore_onto_mesh(tmp_path):
 def test_sharded_checkpoint_missing(tmp_path):
     with pytest.raises(mx.base.MXNetError, match="no sharded checkpoint"):
         mx.checkpoint.load_sharded_checkpoint(str(tmp_path / "nope"), 0)
+
+
+def _megatron_lm_module():
+    from mxnet_tpu import sharding
+    from mxnet_tpu.models.transformer import get_transformer_lm
+
+    net = get_transformer_lm(vocab_size=64, num_layers=1, num_heads=2,
+                             hidden=32, seq_len=16, block_q=16, block_k=16)
+    mesh = sharding.build_mesh("data=-1,model=2")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8, 16))],
+             mesh=mesh, partition_rules="transformer_megatron")
+    mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
+    return mod
+
+
+def test_partition_spec_metadata_roundtrip_onto_fresh_mesh(tmp_path):
+    """Tensor-parallel save -> spec metadata on disk -> restore onto a
+    FRESH mesh reproduces the layout without explicit shardings."""
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu import sharding
+
+    mod = _megatron_lm_module()
+    executor = mod._exec_group.execs[0]
+    args = {k: executor.arg_dict[k] for k in mod._exec_group.param_names}
+    auxs = {k: executor.aux_dict[k] for k in mod._exec_group.aux_names}
+    prefix = str(tmp_path / "tp")
+    mx.checkpoint.save_sharded_checkpoint(prefix, 3, mod.symbol, args, auxs)
+
+    specs = mx.checkpoint.load_partition_specs(prefix, 3)
+    assert specs["arg"]["layer0_qkv_weight"] == P("model", None)
+    assert specs["arg"]["layer0_proj_weight"] == P(None, "model")
+    assert specs["arg"]["ln_f_gamma"] == P()
+
+    fresh = sharding.build_mesh("data=-1,model=2")
+    _, args2, _ = mx.checkpoint.load_sharded_checkpoint(prefix, 3, mesh=fresh)
+    w = args2["layer0_qkv_weight"]._data
+    assert w.sharding.mesh is fresh.abstract_mesh or \
+        sharding.mesh_axes(w.sharding.mesh) == {"data": 4, "model": 2}
+    assert w.sharding.spec == P("model", None)
+    assert {tuple(s.data.shape) for s in w.addressable_shards} == {(48, 32)}
+    np.testing.assert_allclose(
+        np.asarray(w), args["layer0_qkv_weight"].asnumpy(), rtol=1e-6)
+
+
+def test_mesh_restore_rejects_unknown_axis(tmp_path):
+    from jax.sharding import Mesh
+
+    import jax
+
+    mod = _megatron_lm_module()
+    executor = mod._exec_group.execs[0]
+    args = {k: executor.arg_dict[k] for k in mod._exec_group.param_names}
+    prefix = str(tmp_path / "tp2")
+    mx.checkpoint.save_sharded_checkpoint(prefix, 1, None, args, {})
+
+    wrong = Mesh(np.array(jax.devices()).reshape(4, 2), ("x", "y"))
+    with pytest.raises(mx.base.MXNetError, match="mesh axes"):
+        mx.checkpoint.load_sharded_checkpoint(prefix, 1, mesh=wrong)
