@@ -257,13 +257,23 @@ class CommEngine:
             op.event.wait()
         self.raise_failures()
 
-    def wait_all(self):
+    def wait_all(self, timeout=None):
         """Block until the engine drains (WaitForAll), then surface the
-        first recorded failure."""
+        first recorded failure.  With ``timeout`` (seconds) the wait is
+        bounded: returns False if ops were still outstanding when it
+        expired (nothing is cancelled), True when drained."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._idle_cv:
             while self._outstanding:
-                self._idle_cv.wait()
+                if deadline is None:
+                    self._idle_cv.wait()
+                    continue
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._idle_cv.wait(left)
         self.raise_failures()
+        return True
 
     def raise_failures(self):
         with self._lock:
@@ -580,11 +590,24 @@ class AsyncKVStore(KVStore):
         self._engine.wait(keys)
         self.metrics.note_wait(time.perf_counter() - t0)
 
-    def wait_all(self):
+    def wait_all(self, timeout=None):
         self.flush()
         t0 = time.perf_counter()
-        self._engine.wait_all()
+        done = self._engine.wait_all(timeout)
         self.metrics.note_wait(time.perf_counter() - t0)
+        return done
+
+    def drain(self, timeout=None):
+        """Preemption drain (docs/how_to/fault_tolerance.md §elasticity):
+        flush the coalescing buffers and wait — bounded by ``timeout``
+        seconds — for every in-flight op, swallowing op failures: a
+        worker about to ``leave`` must get its final grads out if it
+        can, not die on a push error mid-teardown.  Returns True when
+        the engine drained."""
+        try:
+            return bool(self.wait_all(timeout))
+        except MXNetError:
+            return True  # drained; pending failures surfaced and dropped
 
     # -- control plane (drain first: ordering + recovery semantics) --------
     def init(self, key, value):
@@ -615,7 +638,7 @@ class AsyncKVStore(KVStore):
         self.wait_all()
         self._kv.load_optimizer_states(fname)
 
-    def get_num_dead_node(self, node_id=0, timeout=60):
+    def get_num_dead_node(self, node_id=0, timeout=None):
         return self._kv.get_num_dead_node(node_id, timeout) \
             if hasattr(self._kv, "get_num_dead_node") else 0
 

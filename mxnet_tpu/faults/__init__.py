@@ -3,8 +3,9 @@
 The robustness layer the distributed stack is hardened against
 (docs/how_to/fault_tolerance.md).  Socket and file I/O sites across the
 kvstore transport (``kvstore_server.py``), checkpoint writer
-(``filesystem.atomic_write``) and dist heartbeats name themselves with
-dotted operation strings and call :func:`fire` before touching the real
+(``filesystem.atomic_write``), dist heartbeats, and the elastic
+membership evictor (``kv.server.evict``) name themselves with dotted
+operation strings and call :func:`fire` before touching the real
 resource; an installed :class:`FaultPlan` then injects connection drops,
 delays, torn writes, or process kills on a reproducible schedule.
 

@@ -16,6 +16,8 @@ returns the stored weight; without one, pull returns the summed grads.
 """
 from __future__ import annotations
 
+import logging
+import os
 import pickle
 from typing import Callable, Dict, List, Optional, Union
 
@@ -26,12 +28,25 @@ from .ndarray import NDArray
 from . import ndarray as nd
 from . import optimizer as opt
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "create", "install_preemption_handler"]
 
 register_env("MXNET_KVSTORE_COMPRESS", "", str,
              "Wire compression for dist_async push payloads: 'fp16' halves "
              "gradient bytes with per-key error-feedback residuals "
              "(convergence-preserving); empty disables.")
+register_env("MXNET_KVSTORE_ELASTIC", 0, int,
+             "Elastic membership for dist_async: workers join the server's "
+             "live-rank table, barriers and sync rounds size themselves by "
+             "the current generation, and a preemption handler is installed "
+             "on the Module path (fault_tolerance.md §elasticity).")
+register_env("MXNET_KVSTORE_ELASTIC_JOIN", 0, int,
+             "Set by launch.py --elastic on respawned workers: this process "
+             "is a mid-run joiner — it rides the recovery bring-up (skip "
+             "startup barriers, pull current params) and aligns with the "
+             "fleet at the next barrier.")
+register_env("MXNET_KVSTORE_DRAIN_TIMEOUT", 30, float,
+             "Seconds the preemption handler waits for in-flight comm-engine "
+             "ops to drain before checkpointing and leaving.")
 
 
 def _key_list(key):
@@ -144,6 +159,12 @@ class KVStore:
         """Block until every outstanding op has completed (no-op here;
         see ``wait``)."""
 
+    def drain(self, timeout=None):
+        """Finish outstanding async work before a preemption exit (no-op
+        for synchronous stores; the comm-engine facade overrides this
+        with a bounded wait).  Returns True once everything completed."""
+        return True
+
     # -- control plane -----------------------------------------------------
     def set_optimizer(self, optimizer):
         """Install an optimizer as the store-side updater.  In dist mode the
@@ -242,12 +263,30 @@ class DistAsyncKVStore(KVStore):
                 % comp)
         self._compress = comp
         self._residuals: Dict[object, np.ndarray] = {}
+        # elastic membership (docs/how_to/fault_tolerance.md §elasticity):
+        # join every server's live-rank table so barriers and sync rounds
+        # size themselves by the current generation.  A mid-run joiner
+        # (MXNET_KVSTORE_ELASTIC_JOIN, set by launch.py --elastic on
+        # respawns) additionally rides the recovery bring-up so it pulls
+        # current params and aligns at the NEXT barrier instead of
+        # waiting at startup ones.
+        self._elastic = os.environ.get("MXNET_KVSTORE_ELASTIC", "0") == "1"
+        self._left = False
+        if os.environ.get("MXNET_KVSTORE_ELASTIC_JOIN", "0") == "1":
+            self._is_recovery = True
         # liveness: periodic heartbeat so the server can report dead peers
         # and release stuck barriers (kvstore_dist.h:151-160 parity)
-        self._client.start_heartbeat(
-            self._rank,
-            interval=float(os.environ.get("MXNET_KVSTORE_HEARTBEAT_INTERVAL",
-                                          "5")))
+        hb_interval = float(os.environ.get(
+            "MXNET_KVSTORE_HEARTBEAT_INTERVAL", "5"))
+        if self._elastic:
+            for c in self._clients:
+                c.join(self._rank)
+                # heartbeat EVERY server: each keeps its own eviction
+                # clock, and a beat to server 0 alone would get this rank
+                # evicted from the rest of the fleet
+                c.start_heartbeat(self._rank, interval=hb_interval)
+        else:
+            self._client.start_heartbeat(self._rank, interval=hb_interval)
 
     @property
     def rank(self) -> int:
@@ -486,20 +525,45 @@ class DistAsyncKVStore(KVStore):
         return [p if p.shape == h.shape else p.reshape(h.shape)
                 for p, h in zip(jnp.split(big, offs), hosts)]
 
-    def get_num_dead_node(self, node_id=0, timeout=60):
+    def get_num_dead_node(self, node_id=0, timeout=None):
         """Count workers whose heartbeat went stale (reference
         kvstore.get_num_dead_node over ps::Postoffice::GetDeadNodes,
-        kvstore_dist.h:151-160)."""
+        kvstore_dist.h:151-160).  ``timeout=None`` uses the server's own
+        ``MXNET_KVSTORE_HEARTBEAT_TIMEOUT`` default, so callers and the
+        barrier dead-peer release agree on who is dead."""
         try:
-            return len(self._client.dead_nodes(float(timeout)))
+            return len(self._client.dead_nodes(
+                None if timeout is None else float(timeout)))
         except Exception:
             # server unreachable: from this worker's view the service
             # itself is dead
             return 1
 
+    # -- elastic membership -------------------------------------------------
+    def membership(self):
+        """Live membership view ``{gen, ranks, num_workers}``."""
+        return self._client.membership()
+
+    def leave(self):
+        """Graceful preemption exit: drop this rank from every server's
+        live set so the survivors' barriers and merge rounds re-form
+        immediately.  Idempotent; failures are logged, not raised — a
+        leaving worker cannot do anything about a dead server."""
+        if self._left:
+            return
+        self._left = True
+        for c in self._clients:
+            try:
+                c.leave(self._rank)
+            except Exception as e:
+                logging.warning("kvstore leave(rank=%d) failed: %s",
+                                self._rank, e)
+
     def close(self):
         """Tear down the client sockets and any in-process server."""
         try:
+            if self._elastic:
+                self.leave()
             if self._pool is not None:
                 self._pool.shutdown(wait=False)
                 self._pool = None
@@ -541,6 +605,59 @@ class DistAsyncKVStore(KVStore):
 
     def load_optimizer_states(self, fname):
         raise MXNetError("Cannot load states for distributed training")
+
+
+def install_preemption_handler(kv, checkpoint_fn=None, sig=None,
+                               drain_timeout=None, exit_process=True):
+    """Install the elastic preemption path on ``sig`` (default SIGTERM):
+    drain in-flight comm-engine ops (bounded by
+    ``MXNET_KVSTORE_DRAIN_TIMEOUT``), run ``checkpoint_fn`` if given,
+    send the ``leave`` RPC so the surviving fleet re-forms immediately,
+    and exit 0 — a clean preemption must not look like a crash to
+    ``launch.py`` auto-resume.  Returns the handler (tests invoke it
+    directly); the signal itself is only hooked from the main thread
+    (``signal.signal`` constraint — elsewhere the handler comes back
+    uninstalled)."""
+    import signal as _signal
+    import threading
+
+    if sig is None:
+        sig = _signal.SIGTERM
+    if drain_timeout is None:
+        drain_timeout = float(os.environ.get(
+            "MXNET_KVSTORE_DRAIN_TIMEOUT", "30"))
+    fired = threading.Event()
+
+    def handler(signum=None, frame=None):
+        if fired.is_set():
+            return
+        fired.set()
+        logging.info("preemption signal: draining comm ops "
+                     "(%.0fs budget), checkpointing, leaving", drain_timeout)
+        try:
+            kv.drain(drain_timeout)
+        except Exception as e:
+            logging.warning("preemption drain failed: %s", e)
+        if checkpoint_fn is not None:
+            try:
+                checkpoint_fn()
+            except Exception as e:
+                logging.warning("preemption checkpoint failed: %s", e)
+        leave = getattr(kv, "leave", None)
+        if leave is not None:
+            try:
+                leave()
+            except Exception as e:
+                logging.warning("preemption leave failed: %s", e)
+        if exit_process:
+            os._exit(0)
+
+    if threading.current_thread() is threading.main_thread():
+        try:
+            _signal.signal(sig, handler)
+        except (ValueError, OSError):
+            pass
+    return handler
 
 
 def create(name="local") -> KVStore:

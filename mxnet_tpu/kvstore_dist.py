@@ -251,17 +251,24 @@ class DistSyncKVStore(KVStore):
         except Exception:
             return None
 
-    def get_num_dead_node(self, node_id=0, timeout=60):
+    def get_num_dead_node(self, node_id=0, timeout=None):
         """Count workers whose heartbeat counter has stopped advancing for
         ``timeout`` seconds of the CALLER's monotonic clock (no cross-host
         wall-clock comparison, so clock skew cannot fabricate or mask
         deaths).  The first observation of a rank establishes its baseline,
         so detection needs two calls at least ``timeout`` apart — collectives
         on this runtime additionally fail fast on lost peers.  Reference:
-        kvstore_dist.h:151-160."""
+        kvstore_dist.h:151-160.  ``timeout=None`` takes the shared
+        ``MXNET_KVSTORE_HEARTBEAT_TIMEOUT`` default so every liveness
+        consumer agrees on who is dead."""
         import time
 
         import jax
+
+        if timeout is None:
+            from .kvstore_server import _hb_timeout_default
+
+            timeout = _hb_timeout_default()
 
         if jax.process_count() == 1:
             return 0

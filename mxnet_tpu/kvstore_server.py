@@ -51,10 +51,24 @@ from . import telemetry as _telemetry
 from .base import register_env
 
 __all__ = ["KVStoreServer", "start_server", "ServerClient",
-           "_init_kvstore_server_module"]
+           "KVStoreConnectionError", "_init_kvstore_server_module"]
 
 register_env("MXNET_KVSTORE_RETRY_MAX", 10, int,
              "Max reconnect/replay attempts per kvstore client RPC.")
+register_env("MXNET_KVSTORE_RETRY_DEADLINE", 0, float,
+             "Overall wall-clock cap in seconds on a client RPC's "
+             "reconnect/replay loop; 0 disables.  Once exceeded the RPC "
+             "fails with KVStoreConnectionError instead of burning the "
+             "remaining per-attempt budget (an evicted worker fails fast).")
+register_env("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", 60, float,
+             "Seconds of heartbeat silence before a rank counts as dead — "
+             "the shared default for the dead_nodes RPC and the barrier "
+             "dead-peer release.")
+register_env("MXNET_KVSTORE_EVICT_TIMEOUT", 0, float,
+             "Elastic membership: seconds of heartbeat silence before a "
+             "JOINED rank is evicted from the live set (its partial merge "
+             "contributions discarded, barriers and sync rounds re-formed "
+             "around the survivors).  0 disables eviction.")
 register_env("MXNET_KVSTORE_RETRY_INITIAL_MS", 50, float,
              "First retry backoff in ms (doubles per attempt).")
 register_env("MXNET_KVSTORE_RETRY_MAX_MS", 2000, float,
@@ -87,7 +101,28 @@ def _retry_conf():
         "cap": float(os.environ.get("MXNET_KVSTORE_RETRY_MAX_MS",
                                     "2000")) / 1e3,
         "jitter": float(os.environ.get("MXNET_KVSTORE_RETRY_JITTER", "0.2")),
+        "deadline": float(os.environ.get("MXNET_KVSTORE_RETRY_DEADLINE",
+                                         "0")),
     }
+
+
+def _hb_timeout_default():
+    """The ONE heartbeat-staleness default shared by the ``dead_nodes``
+    RPC and the barrier dead-peer release, so eviction and barrier-abort
+    agree on who is dead.  ``MXNET_KVSTORE_DEAD_TIMEOUT`` is honored as a
+    legacy alias."""
+    v = os.environ.get("MXNET_KVSTORE_HEARTBEAT_TIMEOUT")
+    if v is None:
+        v = os.environ.get("MXNET_KVSTORE_DEAD_TIMEOUT")
+    return float(v) if v is not None else 60.0
+
+
+class KVStoreConnectionError(ConnectionError):
+    """A kvstore client RPC gave up: the per-attempt retry budget or the
+    ``MXNET_KVSTORE_RETRY_DEADLINE`` wall-clock cap was exhausted.
+    Subclasses ConnectionError, so existing transport handlers still
+    catch it; callers that care (an evicted worker deciding to exit) can
+    match the type."""
 
 
 def _backoff_sleep(attempt, conf):
@@ -194,6 +229,17 @@ def _srv_metrics():
                 "Duration of the last durable snapshot (ms)."),
             "snaps": reg.counter(
                 "mxtpu_kvsrv_snapshots_total", "Durable snapshots written."),
+            "members": reg.gauge(
+                "mxtpu_kvsrv_members",
+                "Live ranks in the elastic membership table."),
+            "joins": reg.counter(
+                "mxtpu_kvsrv_joins_total", "Membership join RPCs admitted."),
+            "leaves": reg.counter(
+                "mxtpu_kvsrv_leaves_total", "Graceful membership leaves."),
+            "evictions": reg.counter(
+                "mxtpu_kvsrv_evictions_total",
+                "Ranks evicted for heartbeat staleness (or by the evict "
+                "RPC)."),
         }
     return _TELEM
 
@@ -216,10 +262,20 @@ class KVStoreServer:
       atomic CRC-checked snapshot every ``snapshot_interval`` seconds, on
       clean stop, and on the ``snapshot`` command; a restarted server
       restores it and re-admits reconnecting workers mid-barrier.
+
+    Elastic membership (docs/how_to/fault_tolerance.md §elasticity): once
+    workers ``join`` the live-rank table, barriers and sync-merge rounds
+    are sized by the CURRENT membership generation instead of the static
+    ``num_workers`` — a graceful ``leave`` (preemption) or a stale-
+    heartbeat eviction (``MXNET_KVSTORE_EVICT_TIMEOUT``, kill -9) shrinks
+    the job and renormalizes gradient averaging by the live count; a
+    mid-run ``join`` grows it back.  Membership is journaled into the
+    snapshots (v3) so restarts preserve the live set.
     """
 
     def __init__(self, host="127.0.0.1", port=0, num_workers=1,
-                 sync_mode=False, snapshot_path=None, snapshot_interval=None):
+                 sync_mode=False, snapshot_path=None, snapshot_interval=None,
+                 evict_timeout=None):
         self.num_workers = num_workers
         self.sync_mode = sync_mode
         self.store: Dict[object, np.ndarray] = {}
@@ -230,6 +286,19 @@ class KVStoreServer:
         self._barrier_cv = threading.Condition()
         self._merge: Dict[object, list] = {}
         self._stop = threading.Event()
+        # elastic membership (docs/how_to/fault_tolerance.md §elasticity):
+        # the live-rank set replaces the static num_workers in barriers
+        # and sync-merge rounds once workers join; _mgen is a monotonic
+        # generation bumped on every join/leave/evict so clients can
+        # detect membership changes.  Lock ordering: membership is
+        # guarded by _lock, mutated only while holding _barrier_cv first
+        # (the established _barrier_cv -> _lock order), so barrier
+        # release and merge-round flushing observe one consistent set.
+        self._members: set = set()
+        self._mgen = 0
+        self._evict_timeout = float(
+            evict_timeout if evict_timeout is not None
+            else os.environ.get("MXNET_KVSTORE_EVICT_TIMEOUT", "0"))
         # liveness: rank -> monotonic time of last heartbeat (reference:
         # ps::Postoffice node tracking behind GetDeadNodes,
         # kvstore_dist.h:151-160)
@@ -243,6 +312,11 @@ class KVStoreServer:
         self._dedup: Dict[str, dict] = {}
         self._dedup_cv = threading.Condition()
         self.applied_pushes = 0  # distinct (non-replayed) push applications
+        # contribution-count histogram of flushed sync-merge rounds
+        # ({3: 40, 2: 7} = 40 full rounds, 7 renormalized 2-worker rounds);
+        # chaos tests read it to prove shrink/grow actually changed round
+        # composition rather than stalling the job
+        self.round_sizes: Dict[int, int] = {}
         self.restored = False
         self.snapshot_path = snapshot_path if snapshot_path is not None \
             else (os.environ.get("MXNET_KVSTORE_SNAPSHOT_PATH") or None)
@@ -314,6 +388,11 @@ class KVStoreServer:
                 target=self._snapshot_loop, name="kvsrv-snapshot",
                 daemon=True)
             self._snap_thread.start()
+        self._evict_thread = None
+        if self._evict_timeout > 0:
+            self._evict_thread = threading.Thread(
+                target=self._evictor_loop, name="kvsrv-evictor", daemon=True)
+            self._evict_thread.start()
 
     # -- idempotent request admission --------------------------------------
     def _serve_one(self, cid, seq, msg):
@@ -403,6 +482,12 @@ class KVStoreServer:
             key, arr = msg[1], msg[2]
             rank = msg[3] if len(msg) > 3 else 0
             with self._lock:
+                if self.sync_mode and self._members \
+                        and rank not in self._members:
+                    # an evicted/left rank's in-flight push: ack it (the
+                    # client would retry an error) but keep it out of the
+                    # survivors' merge rounds
+                    return ("ok",)
                 stored = self.store.get(key)
                 if stored is not None and \
                         np.asarray(arr).dtype != stored.dtype:
@@ -425,9 +510,7 @@ class KVStoreServer:
                             break
                     if not placed:
                         rounds.append({rank: np.asarray(arr)})
-                    if rounds and len(rounds[0]) >= self.num_workers:
-                        merged = np.sum(list(rounds.pop(0).values()), axis=0)
-                        self._apply(key, merged)
+                    self._flush_rounds_locked(key)
                 else:
                     self._apply(key, np.asarray(arr))
             return ("ok",)
@@ -453,15 +536,54 @@ class KVStoreServer:
                 self._heartbeats[rank] = time.monotonic()
             return ("ok",)
         if cmd == "dead_nodes":
-            timeout_s = float(msg[1]) if len(msg) > 1 else 60.0
+            timeout_s = (float(msg[1])
+                         if len(msg) > 1 and msg[1] is not None
+                         else _hb_timeout_default())
             return ("ok", self._dead_nodes(timeout_s))
+        if cmd == "join":
+            # elastic membership entry: admit the rank into the live set,
+            # bump the generation, baseline its heartbeat (the eviction
+            # clock must not start before the worker's first beat), and
+            # hand back the fleet view so a mid-run joiner can align
+            rank = int(msg[1])
+            with self._barrier_cv:
+                with self._lock:
+                    fresh = rank not in self._members
+                    self._members.add(rank)
+                    self._heartbeats[rank] = time.monotonic()
+                    if fresh:
+                        self._mgen += 1
+                    gen = self._mgen
+                    ranks = sorted(self._members)
+                self._barrier_cv.notify_all()
+            if fresh:
+                self._note_membership("join", rank, gen, ranks)
+                logging.info("kvstore membership: rank %d joined (gen %d, "
+                             "live %s)", rank, gen, ranks)
+            return ("ok", {"gen": gen, "ranks": ranks,
+                           "num_workers": self.num_workers})
+        if cmd == "leave":
+            # graceful preemption exit: drop the rank NOW so survivors'
+            # barriers and merge rounds re-form without waiting for the
+            # eviction timeout
+            with self._barrier_cv:
+                gen = self._evict_members_locked([int(msg[1])], "leave")
+            return ("ok", gen)
+        if cmd == "evict":
+            with self._barrier_cv:
+                gen = self._evict_members_locked([int(msg[1])], "evict rpc")
+            return ("ok", gen)
+        if cmd == "membership":
+            with self._lock:
+                return ("ok", {"gen": self._mgen,
+                               "ranks": sorted(self._members),
+                               "num_workers": self.num_workers})
         if cmd == "barrier":
             rank = int(msg[1]) if len(msg) > 1 else 0
             is_recovery = bool(msg[2]) if len(msg) > 2 else False
             timeout = float(os.environ.get("MXNET_KVSTORE_BARRIER_TIMEOUT",
                                            "600"))
-            hb_timeout = float(os.environ.get(
-                "MXNET_KVSTORE_DEAD_TIMEOUT", "60"))
+            hb_timeout = _hb_timeout_default()
             deadline = time.monotonic() + timeout
             with self._barrier_cv:
                 # rejoin semantics (reference kvstore_dist.h:35-38): a
@@ -477,14 +599,13 @@ class KVStoreServer:
                     return ("ok",)
                 gen = self._barrier_gen
                 self._barrier_ranks.add(rank)
-                if len(self._barrier_ranks) >= self.num_workers:
-                    self._barrier_ranks = set()
-                    self._barrier_gen += 1
-                    self._barrier_cv.notify_all()
+                if self._try_release_barrier_locked():
                     return ("ok",)
                 # wake periodically: a dead peer (stale heartbeat) releases
-                # the barrier with an error instead of hanging the job until
-                # the full timeout (reference: GetDeadNodes lets callers
+                # the barrier instead of hanging the job until the full
+                # timeout — by EVICTION (elastic mode: the barrier re-forms
+                # around the survivors and training continues) or by abort
+                # (static mode, reference GetDeadNodes semantics: callers
                 # observe the failure; a dead worker otherwise wedges the
                 # server's merge-until-NumWorkers forever)
                 while True:
@@ -494,12 +615,21 @@ class KVStoreServer:
                                              0.01)))
                     if released:
                         return ("ok",)
-                    dead = self._dead_nodes(hb_timeout)
-                    if dead:
-                        if self._barrier_gen == gen:
-                            self._barrier_ranks.discard(rank)
-                        return ("err", "barrier aborted: dead workers %s"
-                                % dead)
+                    if self._evict_timeout > 0 and self._members:
+                        stale = self._stale_members(self._evict_timeout)
+                        if stale:
+                            self._evict_members_locked(stale,
+                                                       "stale heartbeat")
+                            if self._barrier_gen != gen:
+                                return ("ok",)
+                    else:
+                        dead = self._dead_nodes(hb_timeout)
+                        if dead:
+                            if self._barrier_gen == gen:
+                                self._barrier_ranks.discard(rank)
+                            return ("err",
+                                    "barrier aborted: dead workers %s"
+                                    % dead)
                     if time.monotonic() >= deadline:
                         if self._barrier_gen == gen:
                             self._barrier_ranks.discard(rank)
@@ -532,6 +662,134 @@ class KVStoreServer:
             return sorted(r for r, t in self._heartbeats.items()
                           if now - t > timeout_s)
 
+    # -- elastic membership ------------------------------------------------
+    def _round_complete_locked(self, rnd):
+        """A sync-merge round is ready when every live member contributed;
+        before any member joined (legacy static launch) the round counts
+        ``num_workers`` contributions instead.  Caller holds ``_lock``."""
+        if self._members:
+            return self._members <= set(rnd)
+        return len(rnd) >= self.num_workers
+
+    def _flush_rounds_locked(self, key):
+        """Apply every leading complete merge round for ``key`` (caller
+        holds ``_lock``).  When the live set has shrunk below the nominal
+        worker count, the merged gradient is renormalized by
+        ``num_workers / len(round)``: the worker-side optimizer scales by
+        ``1/num_workers`` (gradient averaging over the launch-time fleet),
+        so without the correction a shrink would silently shrink the
+        effective learning rate too."""
+        rounds = self._merge.get(key)
+        while rounds and self._round_complete_locked(rounds[0]):
+            rnd = rounds.pop(0)
+            self.round_sizes[len(rnd)] = self.round_sizes.get(len(rnd), 0) + 1
+            merged = np.sum(list(rnd.values()), axis=0)
+            if self._members and len(rnd) != self.num_workers:
+                merged = np.asarray(
+                    merged * (float(self.num_workers) / len(rnd)),
+                    dtype=merged.dtype)
+            self._apply(key, merged)
+
+    def _try_release_barrier_locked(self):
+        """Release the parked barrier if every required rank has arrived
+        (caller holds ``_barrier_cv``).  Elastic mode: the required set is
+        the live membership (extra arrivals — a rank evicted after
+        parking — never block); static mode keeps the ``num_workers``
+        count."""
+        with self._lock:
+            members = set(self._members)
+        if members:
+            ready = members <= self._barrier_ranks
+        else:
+            ready = len(self._barrier_ranks) >= self.num_workers
+        if ready:
+            self._barrier_ranks = set()
+            self._barrier_gen += 1
+            self._barrier_cv.notify_all()
+        return ready
+
+    def _stale_members(self, timeout_s):
+        """Live members whose heartbeat is older than ``timeout_s``.  A
+        member with no heartbeat record yet (snapshot restore, join race)
+        is re-baselined to now rather than instantly evicted."""
+        now = time.monotonic()
+        with self._lock:
+            out = []
+            for r in sorted(self._members):
+                t = self._heartbeats.get(r)
+                if t is None:
+                    self._heartbeats[r] = now
+                elif now - t > timeout_s:
+                    out.append(r)
+            return out
+
+    def _evict_members_locked(self, ranks, reason):
+        """Remove ``ranks`` from the live membership (caller holds
+        ``_barrier_cv``): bump the generation, discard their partial
+        merge-round contributions, flush any rounds the shrunken set now
+        completes, re-form a parked barrier around the survivors, and
+        emit telemetry.  Returns the membership generation."""
+        with self._lock:
+            gone = [r for r in ranks if r in self._members]
+            for r in gone:
+                self._members.discard(r)
+                self._heartbeats.pop(r, None)
+            if gone:
+                self._mgen += 1
+                for rounds in self._merge.values():
+                    for rnd in rounds:
+                        for r in gone:
+                            rnd.pop(r, None)
+                for key in list(self._merge):
+                    self._flush_rounds_locked(key)
+            gen = self._mgen
+            ranks_now = sorted(self._members)
+        if gone:
+            self._barrier_ranks -= set(gone)
+            if self._barrier_ranks:
+                self._try_release_barrier_locked()
+            self._barrier_cv.notify_all()
+            for r in gone:
+                self._note_membership(
+                    "leave" if reason == "leave" else "evict",
+                    r, gen, ranks_now, reason=reason)
+            logging.info("kvstore membership: %s — rank(s) %s removed "
+                         "(gen %d, live %s)", reason, gone, gen, ranks_now)
+        return gen
+
+    def _note_membership(self, kind, rank, gen, ranks, reason=None):
+        if not _telemetry.enabled():
+            return
+        m = _srv_metrics()
+        m["members"].set(len(ranks))
+        if kind == "join":
+            m["joins"].inc()
+        elif kind == "leave":
+            m["leaves"].inc()
+        else:
+            m["evictions"].inc()
+        fields = {"change": kind, "rank": rank, "gen": gen,
+                  "live": list(ranks)}
+        if reason:
+            fields["reason"] = reason
+        _telemetry.log_event("kvsrv_membership", **fields)
+
+    def _evictor_loop(self):
+        """Background stale-member eviction: a straggler that stops
+        heartbeating for ``MXNET_KVSTORE_EVICT_TIMEOUT`` is removed even
+        when no barrier is parked (async mode, or sync workers stuck
+        waiting on a merge round rather than a barrier)."""
+        poll = max(0.05, min(1.0, self._evict_timeout / 4.0))
+        while not self._stop.wait(poll):
+            try:
+                faults.fire("kv.server.evict")
+                with self._barrier_cv:
+                    stale = self._stale_members(self._evict_timeout)
+                    if stale:
+                        self._evict_members_locked(stale, "stale heartbeat")
+            except Exception as e:
+                logging.warning("kvstore evictor: %s", e)
+
     def _apply(self, key, grad):
         """Run the updater (reference DataHandle: updater_(key, recved,
         &stored)); without one, accumulate like the reference default."""
@@ -548,8 +806,10 @@ class KVStoreServer:
     # -- durable snapshots --------------------------------------------------
     # v2: dedup records are per-client windows {"floor", "window": {seq:
     # reply}} (pipelined transport); v1 single-record snapshots are
-    # converted on restore
-    _SNAP_VERSION = 2
+    # converted on restore.  v3 adds the elastic membership table
+    # ("members", "mgen") so a restarted server re-forms around the same
+    # live set instead of forgetting who was in the job.
+    _SNAP_VERSION = 3
 
     def snapshot(self):
         """Write the full server state to ``snapshot_path`` atomically
@@ -571,6 +831,8 @@ class KVStoreServer:
                                           pickle.HIGHEST_PROTOCOL)
                             if self.updater is not None else None)
             applied = self.applied_pushes
+            members = sorted(self._members)
+            mgen = self._mgen
         with self._dedup_cv:
             dedup = {cid: {"floor": rec["floor"],
                            "window": {s: e["reply"]
@@ -587,6 +849,8 @@ class KVStoreServer:
             "applied_pushes": applied,
             "num_workers": self.num_workers,
             "sync_mode": self.sync_mode,
+            "members": members,
+            "mgen": mgen,
         }
         payload = pickle.dumps(state, pickle.HIGHEST_PROTOCOL)
         atomic_write(self.snapshot_path, lambda f: f.write(payload),
@@ -616,7 +880,7 @@ class KVStoreServer:
         try:
             with open(path, "rb") as f:
                 state = pickle.load(f)
-            if state.get("version") not in (1, self._SNAP_VERSION):
+            if state.get("version") not in (1, 2, self._SNAP_VERSION):
                 raise ValueError("snapshot version %r"
                                  % (state.get("version"),))
             updater = (pickle.loads(state["updater"])
@@ -631,6 +895,14 @@ class KVStoreServer:
                            for k, rounds in state.get("merge", {}).items()}
             self.updater = updater
             self.applied_pushes = int(state.get("applied_pushes", 0))
+            self._members = set(state.get("members", []))
+            self._mgen = int(state.get("mgen", 0))
+            now = time.monotonic()
+            for r in self._members:
+                # restored members get a fresh heartbeat baseline: the
+                # eviction clock restarts with the server instead of
+                # reading as infinitely stale and evicting everyone
+                self._heartbeats[r] = now
         with self._barrier_cv:
             self._barrier_gen = int(state.get("barrier_gen", 0))
         with self._dedup_cv:
@@ -724,8 +996,18 @@ class ServerClient:
         self._reader.start()
 
     # -- transport ---------------------------------------------------------
+    @staticmethod
+    def _deadline_hit(t0, conf):
+        """MXNET_KVSTORE_RETRY_DEADLINE: overall wall-clock cap on one
+        reconnect/replay loop (0 disables).  An evicted worker whose
+        server stopped talking to it fails fast with a typed error
+        instead of burning the remaining per-attempt budget."""
+        return conf["deadline"] > 0 and \
+            time.monotonic() - t0 >= conf["deadline"]
+
     def _connect(self, conf):
         last = None
+        t0 = time.monotonic()
         for attempt in range(conf["retries"] + 1):
             try:
                 faults.fire("kv.client.connect")
@@ -735,12 +1017,14 @@ class ServerClient:
             except OSError as e:
                 last = e
                 self._sock = None
-                if attempt >= conf["retries"]:
+                if attempt >= conf["retries"] or \
+                        self._deadline_hit(t0, conf):
                     break
                 _backoff_sleep(attempt, conf)
-        raise ConnectionError(
-            "kvstore server %s:%d unreachable after %d attempts: %s"
-            % (self._addr[0], self._addr[1], conf["retries"] + 1, last))
+        raise KVStoreConnectionError(
+            "kvstore server %s:%d unreachable after %d attempts (%.1fs): %s"
+            % (self._addr[0], self._addr[1], attempt + 1,
+               time.monotonic() - t0, last))
 
     def _kill_sock_locked(self):
         """Drop the socket (caller holds _send_lock).  shutdown() first:
@@ -816,6 +1100,7 @@ class ServerClient:
         server's dedup window turns replays of already-applied requests
         into recorded-reply replays — exactly-once with >1 in flight."""
         conf = _retry_conf()
+        t0 = time.monotonic()
         with self._send_lock:
             if failed is not None and self._sock is not None \
                     and self._sock is not failed:
@@ -826,6 +1111,10 @@ class ServerClient:
                 with self._state_cv:
                     if self._closed or not self._inflight:
                         return
+                if self._deadline_hit(t0, conf):
+                    last = ("retry deadline %.1fs exceeded"
+                            % conf["deadline"]) if last is None else last
+                    break
                 try:
                     faults.fire("kv.client.connect")
                     sock = _nodelay(
@@ -847,7 +1136,7 @@ class ServerClient:
                         # e.g. stop_server(retries=1): once the server
                         # acked and exited, burning the whole budget on a
                         # dead address helps nobody
-                        self._fail_entry(ent, ConnectionError(
+                        self._fail_entry(ent, KVStoreConnectionError(
                             "kvstore rpc %r to %s:%d failed after %d "
                             "attempts" % (ent["env"][3][0], self._addr[0],
                                           self._addr[1], limit + 1)))
@@ -868,14 +1157,15 @@ class ServerClient:
                     continue
                 self._sock = sock
                 return
-            # budget exhausted: fail every waiter
+            # budget (or retry deadline) exhausted: fail every waiter
             with self._state_cv:
                 ents = list(self._inflight.values())
             for ent in ents:
-                self._fail_entry(ent, ConnectionError(
-                    "kvstore rpc %r to %s:%d failed after %d attempts: %s"
+                self._fail_entry(ent, KVStoreConnectionError(
+                    "kvstore rpc %r to %s:%d gave up after %d attempts "
+                    "(%.1fs): %s"
                     % (ent["env"][3][0], self._addr[0], self._addr[1],
-                       conf["retries"] + 1, last)))
+                       attempt + 1, time.monotonic() - t0, last)))
 
     def _fail_entry(self, ent, exc):
         with self._state_cv:
@@ -947,8 +1237,29 @@ class ServerClient:
     def heartbeat(self, rank):
         self._rpc("heartbeat", rank)
 
-    def dead_nodes(self, timeout_s=60.0):
+    def dead_nodes(self, timeout_s=None):
+        """None asks the server for its own MXNET_KVSTORE_HEARTBEAT_TIMEOUT
+        default, so callers and the barrier release agree on who is dead."""
         return self._rpc("dead_nodes", timeout_s)
+
+    # -- elastic membership -------------------------------------------------
+    def join(self, rank):
+        """Enter the live membership table; returns ``{gen, ranks,
+        num_workers}`` so a mid-run joiner can align with the fleet."""
+        return self._rpc("join", rank)
+
+    def leave(self, rank):
+        """Graceful preemption exit: the server drops this rank from
+        barriers and merge rounds immediately (one retry only — a leaving
+        worker must not burn the whole backoff budget on a dead server)."""
+        return self._rpc("leave", rank, retries=1)
+
+    def evict(self, rank):
+        """Administratively remove another rank from the live set."""
+        return self._rpc("evict", rank)
+
+    def membership(self):
+        return self._rpc("membership")
 
     # -- RPC surface -------------------------------------------------------
     def init(self, key, arr):
@@ -1027,11 +1338,13 @@ class ServerClient:
 
 
 def start_server(host="127.0.0.1", port=0, num_workers=1, sync_mode=False,
-                 snapshot_path=None, snapshot_interval=None):
+                 snapshot_path=None, snapshot_interval=None,
+                 evict_timeout=None):
     """Start a server in this process (background thread); returns it."""
     srv = KVStoreServer(host, port, num_workers, sync_mode,
                         snapshot_path=snapshot_path,
-                        snapshot_interval=snapshot_interval)
+                        snapshot_interval=snapshot_interval,
+                        evict_timeout=evict_timeout)
     srv.start_background()
     return srv
 

@@ -8,6 +8,7 @@ reference paths — centralized kvstore update and replicated local updater.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -397,6 +398,16 @@ class Module(BaseModule):
             kvstore.set_optimizer(self._optimizer)
         else:
             self._updater = opt.get_updater(optimizer)
+
+        if kvstore and "dist" in kvstore.type and \
+                os.environ.get("MXNET_KVSTORE_ELASTIC", "0") == "1":
+            # elastic preemption path (fault_tolerance.md §elasticity):
+            # SIGTERM drains in-flight comm ops, checkpoints if the user
+            # registered save hooks, leaves the membership table, and
+            # exits clean so launch.py counts a preemption, not a crash
+            from ..kvstore import install_preemption_handler
+
+            install_preemption_handler(kvstore)
 
         self.optimizer_initialized = True
         self._fused_ok = self._decide_fused()

@@ -1,0 +1,218 @@
+"""Elastic worker membership tests — the kvstore shrinks and grows
+instead of dying (docs/how_to/fault_tolerance.md §elasticity).
+
+Unit tests drive the in-process sync-mode KVStoreServer through the
+join/leave/evict RPCs and assert the membership-sized merge rounds,
+renormalization, barrier re-forming, and snapshot persistence.  The
+end-to-end churn test replays the ``membership-churn`` chaos scenario
+(tools/chaos_run.py): kill -9 one of three workers under a seeded
+FaultPlan, evict it, finish on two with renormalized gradients, then
+grow back to three with a mid-run joiner.
+"""
+import os
+import signal
+import socket
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore_server as kvs
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _join_all(host, port, ranks):
+    clients = {}
+    for r in ranks:
+        c = kvs.ServerClient(host, port)
+        c.join(r)
+        clients[r] = c
+    return clients
+
+
+def _close_all(clients):
+    for c in clients.values():
+        c.close()
+
+
+def test_join_leave_generations():
+    srv = kvs.start_server(num_workers=3, sync_mode=True)
+    host, port = srv.addr
+    try:
+        clients = _join_all(host, port, [0, 1, 2])
+        view = clients[0].membership()
+        assert view["ranks"] == [0, 1, 2]
+        assert view["gen"] == 3  # one bump per fresh join
+        assert view["num_workers"] == 3
+        # re-join of a live rank is idempotent: no generation churn
+        clients[1].join(1)
+        assert clients[0].membership()["gen"] == 3
+        clients[2].leave(2)
+        view = clients[0].membership()
+        assert view["ranks"] == [0, 1]
+        assert view["gen"] == 4
+        # leave of a gone rank is idempotent too
+        clients[2].leave(2)
+        assert clients[0].membership()["gen"] == 4
+        _close_all(clients)
+    finally:
+        srv.stop()
+
+
+def test_shrink_renormalizes_merge_rounds():
+    """A 2-of-3 round must apply num_workers/len(round) times the merged
+    gradient — otherwise a shrink silently scales the effective learning
+    rate down (workers average by the launch-time fleet size)."""
+    srv = kvs.start_server(num_workers=3, sync_mode=True)
+    host, port = srv.addr
+    try:
+        clients = _join_all(host, port, [0, 1, 2])
+        clients[0].init(0, np.zeros(4, np.float32))
+        for r in (0, 1, 2):
+            clients[r].push(0, np.ones(4, np.float32), rank=r)
+        np.testing.assert_allclose(clients[0].pull(0), np.full(4, 3.0))
+        clients[2].leave(2)
+        for r in (0, 1):
+            clients[r].push(0, np.ones(4, np.float32), rank=r)
+        # 2 contributions renormalized by 3/2 -> the same +3.0 per round
+        np.testing.assert_allclose(clients[0].pull(0), np.full(4, 6.0))
+        assert srv.round_sizes == {3: 1, 2: 1}
+        # a push from the departed rank is discarded, not merged
+        clients[2].push(0, np.full(4, 100.0, np.float32), rank=2)
+        for r in (0, 1):
+            clients[r].push(0, np.ones(4, np.float32), rank=r)
+        np.testing.assert_allclose(clients[0].pull(0), np.full(4, 9.0))
+        _close_all(clients)
+    finally:
+        srv.stop()
+
+
+def test_midrun_join_counts_full_round():
+    """Acceptance: after a new worker joins, the next sync-merge round
+    waits for and counts ALL live contributions — no barrier timeout, no
+    job restart."""
+    srv = kvs.start_server(num_workers=3, sync_mode=True)
+    host, port = srv.addr
+    try:
+        clients = _join_all(host, port, [0, 1])
+        clients[0].init(0, np.zeros(4, np.float32))
+        joiner = kvs.ServerClient(host, port)
+        view = joiner.join(5)
+        assert view["ranks"] == [0, 1, 5]
+        for r in (0, 1):
+            clients[r].push(0, np.ones(4, np.float32), rank=r)
+        # round must NOT flush on 2 of 3 live members
+        np.testing.assert_allclose(clients[0].pull(0), np.zeros(4))
+        joiner.push(0, np.ones(4, np.float32), rank=5)
+        np.testing.assert_allclose(clients[0].pull(0), np.full(4, 3.0))
+        assert srv.round_sizes == {3: 1}
+        joiner.close()
+        _close_all(clients)
+    finally:
+        srv.stop()
+
+
+def test_barrier_reforms_around_evicted_member():
+    """With eviction enabled, a heartbeat-silent member is removed and
+    the parked barrier RELEASES for the survivors (the legacy path
+    aborts with an error instead)."""
+    srv = kvs.start_server(num_workers=2, sync_mode=True,
+                           evict_timeout=0.5)
+    host, port = srv.addr
+    try:
+        survivor = kvs.ServerClient(host, port)
+        survivor.join(0)
+        survivor.start_heartbeat(0, interval=0.1)
+        silent = kvs.ServerClient(host, port)
+        silent.join(1)
+        silent.close()  # preempted without a leave RPC: heartbeats stop
+        t0 = time.monotonic()
+        survivor.barrier(rank=0)  # must release, not raise
+        assert time.monotonic() - t0 < 10
+        assert survivor.membership()["ranks"] == [0]
+        survivor.close()
+    finally:
+        srv.stop()
+
+
+def test_snapshot_roundtrips_membership(tmp_path):
+    """Snapshot v3 journals the membership table; a restarted server
+    re-baselines restored heartbeats so survivors are not instantly
+    evicted as stale."""
+    snap = str(tmp_path / "srv.snap")
+    srv = kvs.start_server(num_workers=3, sync_mode=True,
+                           snapshot_path=snap)
+    host, port = srv.addr
+    clients = _join_all(host, port, [0, 1])
+    clients[0].snapshot()
+    _close_all(clients)
+    srv.stop()
+
+    srv2 = kvs.start_server(num_workers=3, sync_mode=True,
+                            snapshot_path=snap, evict_timeout=30.0)
+    try:
+        assert srv2.restored
+        assert srv2._members == {0, 1}
+        assert srv2._mgen == 2
+        # heartbeats re-baselined to restore time, not restored stale
+        assert srv2._stale_members(5.0) == []
+    finally:
+        srv2.stop()
+
+
+def test_retry_deadline_raises_typed_error(monkeypatch):
+    """MXNET_KVSTORE_RETRY_DEADLINE caps the reconnect loop by wall
+    clock even when the attempt budget is far from exhausted, and the
+    give-up is a typed KVStoreConnectionError (a ConnectionError, so
+    existing handlers still catch it)."""
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "100000")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "20")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_DEADLINE", "0.4")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here
+    t0 = time.monotonic()
+    with pytest.raises(kvs.KVStoreConnectionError, match="unreachable"):
+        kvs.ServerClient("127.0.0.1", port)
+    assert time.monotonic() - t0 < 5
+    assert issubclass(kvs.KVStoreConnectionError, ConnectionError)
+
+
+def test_preemption_handler_drains_checkpoints_leaves(monkeypatch):
+    """SIGTERM path: drain in-flight comm ops, run the checkpoint hook,
+    then leave the membership so survivors re-form immediately."""
+    monkeypatch.delenv("DMLC_PS_ROOT_URI", raising=False)
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_ELASTIC", "1")
+    kv = mx.kvstore.create("dist_async")
+    try:
+        assert kv.membership()["ranks"] == [0]
+        calls = []
+        handler = mx.kvstore.install_preemption_handler(
+            kv, checkpoint_fn=lambda: calls.append("ckpt"),
+            exit_process=False)
+        handler(signal.SIGTERM, None)
+        assert calls == ["ckpt"]
+        assert kv.membership()["ranks"] == []
+        handler(signal.SIGTERM, None)  # idempotent on repeated signals
+        assert calls == ["ckpt"]
+    finally:
+        kv.close()
+
+
+@pytest.mark.chaos
+def test_membership_churn_end_to_end_reproducible():
+    """Acceptance: 3 workers mid-epoch, kill -9 one -> the job completes
+    on 2 with renormalized gradients; a fresh rank joins mid-run and the
+    post-join rounds count the full live set; the final weight is the
+    churn-invariant value on BOTH replays of the same seed."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    from chaos_run import run_membership_churn
+
+    assert run_membership_churn(seed=2, timeout=120.0)
+    assert run_membership_churn(seed=2, timeout=120.0)

@@ -53,6 +53,51 @@ def test_barrier_released_by_dead_worker(monkeypatch):
         srv.stop()
 
 
+def test_barrier_aborts_within_unified_heartbeat_timeout(monkeypatch):
+    """A parked barrier must surface the dead-peer error within (roughly)
+    MXNET_KVSTORE_HEARTBEAT_TIMEOUT — the ONE knob every liveness
+    consumer (dead_nodes RPC, barrier release, DistSync) now reads — not
+    after the much longer barrier timeout."""
+    monkeypatch.setenv("MXNET_KVSTORE_BARRIER_TIMEOUT", "60")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_TIMEOUT", "0.5")
+    srv = kvs.start_server(num_workers=2, sync_mode=True)
+    host, port = srv.addr
+    try:
+        survivor = kvs.ServerClient(host, port)
+        survivor.start_heartbeat(0, interval=0.1)
+        dead = kvs.ServerClient(host, port)
+        dead.heartbeat(1)
+        dead.close()
+        t0 = time.time()
+        with pytest.raises(mx.base.MXNetError, match="dead workers"):
+            survivor.barrier()
+        # the barrier's liveness poll runs once a second, so the abort
+        # lands within timeout + one poll + slack — never the 60s wait
+        assert time.time() - t0 < 5
+        # the RPC view agrees with the barrier's verdict (same default)
+        assert survivor.dead_nodes() == [1]
+        survivor.close()
+    finally:
+        srv.stop()
+
+
+def test_never_heartbeated_ranks_are_not_dead():
+    """Ranks that never heartbeated are simply not tracked: bringing a
+    fleet up slowly must not read as mass death (the launcher owns
+    workers that never came up at all)."""
+    srv = kvs.start_server(num_workers=4, sync_mode=False)
+    host, port = srv.addr
+    try:
+        c = kvs.ServerClient(host, port)
+        c.heartbeat(0)
+        time.sleep(0.3)
+        # rank 0 went stale, ranks 1-3 never beat: only 0 is dead
+        assert c.dead_nodes(timeout_s=0.1) == [0]
+        c.close()
+    finally:
+        srv.stop()
+
+
 def test_dist_async_kvstore_reports_dead_nodes(monkeypatch):
     monkeypatch.delenv("DMLC_PS_ROOT_URI", raising=False)
     monkeypatch.setenv("DMLC_NUM_WORKER", "2")

@@ -18,6 +18,19 @@ The spec/seed reach the command (and every child it spawns, e.g. via
 tools/launch.py) through MXNET_FAULTS_SPEC / MXNET_FAULTS_SEED, which
 mxnet_tpu.faults reads at import.  See docs/how_to/fault_tolerance.md
 for the spec grammar.
+
+Built-in scenarios (no command needed) exercise whole-stack robustness
+properties end to end:
+
+    # elastic membership churn: kill -> evict -> respawn-join
+    python tools/chaos_run.py --scenario membership-churn --seeds 0:5
+
+``membership-churn`` runs N elastic workers against a sync-mode server
+with eviction enabled, hard-kills one mid-run under a seeded FaultPlan
+(the seed picks both the victim rank and the kill step), waits for the
+server to evict it, then joins a fresh rank mid-run and verifies every
+survivor lands on the churn-invariant final weight (see
+tests/elastic_churn_worker.py).
 """
 from __future__ import annotations
 
@@ -27,13 +40,159 @@ import subprocess
 import sys
 
 
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_membership_churn(seed, timeout=120.0, workers=3, steps=10,
+                         join_step=6):
+    """Elastic shrink/grow probe: ``workers`` elastic workers train
+    against a sync-mode server with eviction on; a seeded FaultPlan
+    hard-kills one mid-run (``os._exit(137)`` — kill -9 semantics, no
+    leave RPC), the server evicts it on stale heartbeats and the
+    survivors continue on renormalized merge rounds; a fresh rank then
+    joins mid-run and the job finishes counting the full live set
+    again.  Returns True when the victim died with rc 137, membership
+    shrank and grew back, and every survivor landed on the
+    churn-invariant final weight."""
+    import json
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from mxnet_tpu.kvstore_server import ServerClient
+
+    port = _free_port()
+    victim = seed % workers
+    kill_call = 2 + seed % max(1, join_step - 2)  # 1-based fire() count
+    spec = "churn.worker.step:kill=1@#%d" % kill_call
+    base = dict(os.environ,
+                DMLC_PS_ROOT_URI="127.0.0.1",
+                DMLC_PS_ROOT_PORT=str(port),
+                DMLC_NUM_WORKER=str(workers),
+                MXNET_KVSTORE_ELASTIC="1",
+                MXNET_KVSTORE_HEARTBEAT_INTERVAL="0.2",
+                CHURN_TOTAL_STEPS=str(steps),
+                CHURN_JOIN_STEP=str(join_step),
+                CHURN_EXPECT_MEMBERS=str(workers),
+                CHURN_KILL_RANK=str(victim),
+                CHURN_FAULTS_SPEC=spec,
+                CHURN_FAULTS_SEED=str(seed))
+    # the kill must be rank-gated IN-PROCESS by the worker script: a
+    # plain MXNET_FAULTS_SPEC would reach every worker with the same
+    # seed and kill the whole fleet
+    base.pop("MXNET_FAULTS_SPEC", None)
+    base.setdefault("JAX_PLATFORMS", "cpu")
+    base["PYTHONPATH"] = repo + (
+        os.pathsep + base["PYTHONPATH"] if base.get("PYTHONPATH") else "")
+    worker_py = os.path.join(repo, "tests", "elastic_churn_worker.py")
+    print("chaos_run: membership-churn seed %d: victim rank %d dies at "
+          "step %d/%d (spec %r)" % (seed, victim, kill_call - 1, steps,
+                                    spec), file=sys.stderr, flush=True)
+    server = subprocess.Popen(
+        [sys.executable, "-c", "import mxnet_tpu"],
+        env=dict(base, DMLC_ROLE="server", MXNET_KVSTORE_SYNC="1",
+                 MXNET_KVSTORE_EVICT_TIMEOUT="1.0"),
+        cwd=repo)
+    procs = {}
+    results = {}
+    grown = None
+    try:
+        for r in range(workers):
+            procs[r] = subprocess.Popen(
+                [sys.executable, worker_py],
+                env=dict(base, DMLC_WORKER_ID=str(r)),
+                stdout=subprocess.PIPE, text=True)
+        with ServerClient("127.0.0.1", port) as cli:
+            deadline = time.monotonic() + timeout
+
+            def wait_members(pred, what):
+                while time.monotonic() < deadline:
+                    try:
+                        m = cli.membership()
+                    except Exception:
+                        m = None
+                    if m is not None and pred(m):
+                        return m
+                    time.sleep(0.1)
+                raise RuntimeError("membership-churn: timed out waiting "
+                                   "for %s" % what)
+
+            # kill -> evict: gen counts N joins plus the eviction bump,
+            # which tells a late poll apart from "not everyone joined yet"
+            wait_members(lambda m: m["gen"] >= workers + 1
+                         and len(m["ranks"]) == workers - 1, "eviction")
+            # respawn-join: a fresh rank, never the victim's reused
+            procs[workers] = subprocess.Popen(
+                [sys.executable, worker_py],
+                env=dict(base, DMLC_WORKER_ID=str(workers),
+                         MXNET_KVSTORE_ELASTIC_JOIN="1"),
+                stdout=subprocess.PIPE, text=True)
+            grown = wait_members(lambda m: len(m["ranks"]) == workers,
+                                 "mid-run join")
+            print("chaos_run: membership grew back to %s (gen %d)"
+                  % (grown["ranks"], grown["gen"]),
+                  file=sys.stderr, flush=True)
+            for r, p in procs.items():
+                out, _ = p.communicate(
+                    timeout=max(1.0, deadline - time.monotonic()))
+                line = [l for l in (out or "").splitlines()
+                        if l.startswith("{")]
+                results[r] = (p.returncode,
+                              json.loads(line[-1]) if line else None)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        server.terminate()
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+    ok = True
+    rc, _ = results.pop(victim, (None, None))
+    if rc != 137:
+        print("chaos_run: victim rank %d exited rc %s, expected 137"
+              % (victim, rc), file=sys.stderr, flush=True)
+        ok = False
+    for r, (rc, info) in sorted(results.items()):
+        if rc != 0 or info is None or "final" not in info:
+            print("chaos_run: worker rank %d failed (rc %s, %s)"
+                  % (r, rc, info), file=sys.stderr, flush=True)
+            ok = False
+            continue
+        if not info.get("joiner") and \
+                abs(info["final"] - info["target"]) > 1e-4:
+            print("chaos_run: rank %d final %.6f != invariant %.6f — "
+                  "shrunken rounds were not renormalized"
+                  % (r, info["final"], info["target"]),
+                  file=sys.stderr, flush=True)
+            ok = False
+    return ok
+
+
+_SCENARIOS = {"membership-churn": run_membership_churn}
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="Run a command under a deterministic fault schedule",
-        usage="chaos_run.py --spec SPEC (--seed N | --seeds A:B) "
-              "[--timeout S] -- command ...")
-    parser.add_argument("--spec", required=True,
+        usage="chaos_run.py (--spec SPEC -- command ... | --scenario NAME) "
+              "(--seed N | --seeds A:B) [--timeout S]")
+    parser.add_argument("--spec", default=None,
                         help="fault spec, e.g. 'kv.client.*:drop=0.3'")
+    parser.add_argument("--scenario", choices=sorted(_SCENARIOS),
+                        default=None,
+                        help="run a built-in end-to-end scenario instead "
+                             "of a command")
     parser.add_argument("--seed", type=int, default=None,
                         help="replay one seed")
     parser.add_argument("--seeds", type=str, default=None, metavar="A:B",
@@ -45,8 +204,6 @@ def main():
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
-    if not command:
-        parser.error("no command given (put it after --)")
     if (args.seed is None) == (args.seeds is None):
         parser.error("exactly one of --seed / --seeds is required")
 
@@ -56,11 +213,35 @@ def main():
     else:
         seeds = [args.seed]
 
+    if args.scenario is not None:
+        if command or args.spec:
+            parser.error("--scenario runs its own processes and builds its "
+                         "own rank-gated spec; drop --spec and the command")
+        scenario = _SCENARIOS[args.scenario]
+        failures = []
+        for seed in seeds:
+            ok = scenario(seed, timeout=args.timeout or 120.0)
+            print("chaos_run: scenario %s seed %d -> %s"
+                  % (args.scenario, seed, "ok" if ok else "FAILED"),
+                  file=sys.stderr, flush=True)
+            if not ok:
+                failures.append(seed)
+        if failures:
+            print("chaos_run: failing seeds: %s  (replay one with --seed N)"
+                  % failures, file=sys.stderr, flush=True)
+            sys.exit(1)
+        return
+
+    if not command:
+        parser.error("no command given (put it after --)")
+
     # validate the spec before burning any runtime on it
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from mxnet_tpu.faults import parse_spec
 
+    if not args.spec:
+        parser.error("--spec is required when running a command")
     parse_spec(args.spec)
 
     failures = []

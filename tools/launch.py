@@ -35,6 +35,32 @@ def _free_port():
     return port
 
 
+def parse_elastic(spec):
+    """``MIN:MAX`` (or a bare ``MIN``, meaning MIN:MIN) -> (min, max).
+    The job keeps running while at least MIN workers are live and
+    respawns grow it back toward MAX."""
+    lo, sep, hi = spec.partition(":")
+    try:
+        mn = int(lo)
+        mx = int(hi) if sep else mn
+    except ValueError:
+        raise ValueError("--elastic expects MIN:MAX, got %r" % (spec,))
+    if mn < 1 or mx < mn:
+        raise ValueError("--elastic needs 1 <= MIN <= MAX, got %r" % (spec,))
+    return mn, mx
+
+
+def respawn_delay(attempt, base=1.0, cap=30.0, jitter=0.3, rand=None):
+    """Exponential backoff with multiplicative jitter between respawn
+    attempts (``attempt`` counts from 1): a persistently-crashing
+    process must not be relaunched in a tight loop, and the jitter
+    decorrelates a fleet of respawns hammering one coordinator."""
+    import random
+
+    r = (rand if rand is not None else random.random)()
+    return min(cap, base * (2 ** (attempt - 1))) * (1.0 + jitter * r)
+
+
 def _local_ip():
     """A routable address for DMLC_PS_ROOT_URI in ssh mode (the UDP-connect
     trick; no packet is sent)."""
@@ -86,10 +112,27 @@ def main():
                              "times per worker (checkpoint-based fault "
                              "tolerance: the training script resumes via "
                              "mx.model.find_latest_checkpoint)")
+    parser.add_argument("--elastic", type=str, default=None,
+                        metavar="MIN:MAX",
+                        help="elastic membership: workers join the kvstore "
+                             "server's live-rank table "
+                             "(MXNET_KVSTORE_ELASTIC=1), the job keeps "
+                             "running while at least MIN workers are live, "
+                             "and auto-resume respawns rejoin as FRESH "
+                             "ranks (mid-run join) growing back toward MAX")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.command:
         parser.error("no command given")
+    elastic = None
+    if args.elastic is not None:
+        try:
+            elastic = parse_elastic(args.elastic)
+        except ValueError as e:
+            parser.error(str(e))
+        if not (elastic[0] <= args.num_workers <= elastic[1]):
+            parser.error("--elastic %s must bracket -n %d"
+                         % (args.elastic, args.num_workers))
 
     hosts = None
     if args.launcher == "ssh":
@@ -114,6 +157,8 @@ def main():
         "DMLC_NUM_WORKER": str(args.num_workers),
         "DMLC_NUM_SERVER": str(args.num_servers),
     })
+    if elastic is not None:
+        base_env["MXNET_KVSTORE_ELASTIC"] = "1"
     if hosts is not None and args.num_servers > 0:
         # ssh mode places server i on hosts[i % len]; workers cannot derive
         # that from root_uri+port alone, so publish the authoritative
@@ -150,21 +195,40 @@ def main():
             worker_envs.append(env)
             procs.append(spawn(env, i))
         rc = 0
-        if args.auto_resume:
+        if args.auto_resume or elastic is not None:
             # supervise: a crashed worker comes back (its script resumes
             # from the newest checkpoint) and a crashed SERVER comes back
             # too (restoring its state from MXNET_KVSTORE_SNAPSHOT_PATH if
             # configured — workers ride out the outage through their
             # idempotent-retry transport, no worker restarts needed);
-            # clean exits retire normally
+            # clean exits retire normally.  Respawns wait out an
+            # exponential backoff with jitter (respawn_delay) so a
+            # persistently-crashing process is not relaunched in a tight
+            # loop.  --elastic additionally tolerates shrink (the job
+            # continues while >= MIN workers are live) and respawns join
+            # as FRESH ranks, growing back toward MAX.
             import time
 
             attempts = [0] * args.num_workers
             srv_attempts = [0] * args.num_servers
             live = dict(enumerate(procs))
-            while live:
+            pending = {}      # worker slot -> (ready_at, env, rank)
+            srv_pending = {}  # server idx -> (ready_at, env)
+            next_rank = args.num_workers
+
+            def n_live():
+                return len(live) + len(pending)
+
+            while live or pending:
                 time.sleep(0.2)
+                now = time.monotonic()
+                for i, (t, env) in list(srv_pending.items()):
+                    if now >= t:
+                        del srv_pending[i]
+                        server_procs[i] = spawn(env, i)
                 for i, p in list(enumerate(server_procs)):
+                    if i in srv_pending:
+                        continue
                     r = p.poll()
                     if r is None or r == 0:
                         continue
@@ -173,32 +237,59 @@ def main():
                     srv_attempts[i] += 1
                     env = dict(server_envs[i])
                     env["MXNET_AUTORESUME_ATTEMPT"] = str(srv_attempts[i])
-                    print("launch.py: server %d exited rc=%d; "
-                          "relaunch %d/%d" % (i, r, srv_attempts[i],
-                                              args.auto_resume),
+                    delay = respawn_delay(srv_attempts[i])
+                    print("launch.py: server %d exited rc=%d; relaunch "
+                          "%d/%d in %.1fs (%d attempts left)"
+                          % (i, r, srv_attempts[i], args.auto_resume,
+                             delay, args.auto_resume - srv_attempts[i]),
                           file=sys.stderr, flush=True)
-                    server_procs[i] = spawn(env, i)
+                    srv_pending[i] = (now + delay, env)
+                for slot, (t, env, rank) in list(pending.items()):
+                    if now >= t:
+                        del pending[slot]
+                        p2 = spawn(env, rank)
+                        live[slot] = p2
+                        procs.append(p2)
                 for i, p in list(live.items()):
                     r = p.poll()
                     if r is None:
                         continue
-                    if r != 0 and attempts[i] < args.auto_resume:
+                    del live[i]
+                    if r != 0 and attempts[i] < args.auto_resume and \
+                            (elastic is None or n_live() < elastic[1]):
                         attempts[i] += 1
                         env = dict(worker_envs[i])
                         env["MXNET_AUTORESUME_ATTEMPT"] = str(attempts[i])
                         # rejoin contract (reference kvstore_dist.h:35-38):
                         # recovered workers skip startup barriers
                         env["DMLC_IS_RECOVERY"] = "1"
-                        print("launch.py: worker %d exited rc=%d; "
-                              "relaunch %d/%d" % (i, r, attempts[i],
-                                                  args.auto_resume),
+                        rank = i
+                        if elastic is not None:
+                            # a preempted rank never comes back as itself
+                            # — the server may already have evicted it —
+                            # so the respawn joins mid-run as a FRESH rank
+                            rank = next_rank
+                            next_rank += 1
+                            env["DMLC_WORKER_ID"] = str(rank)
+                            env["MXNET_KVSTORE_ELASTIC_JOIN"] = "1"
+                        delay = respawn_delay(attempts[i])
+                        print("launch.py: worker %d exited rc=%d; relaunch"
+                              " %d/%d as rank %d in %.1fs (%d attempts "
+                              "left)" % (i, r, attempts[i],
+                                         args.auto_resume, rank, delay,
+                                         args.auto_resume - attempts[i]),
                               file=sys.stderr, flush=True)
-                        p2 = spawn(env, i)
-                        live[i] = p2
-                        procs.append(p2)
+                        pending[i] = (now + delay, env, rank)
+                    elif elastic is not None and r != 0 and \
+                            n_live() >= elastic[0]:
+                        # preemption the job absorbs: the fleet shrank but
+                        # stays at or above MIN — not a job failure
+                        print("launch.py: worker %d retired rc=%d; "
+                              "continuing elastically with %d live "
+                              "(min %d)" % (i, r, n_live(), elastic[0]),
+                              file=sys.stderr, flush=True)
                     else:
                         rc = rc or r
-                        del live[i]
         else:
             for p in procs:
                 p.wait()
