@@ -49,6 +49,7 @@ from .ndarray import NDArray
 from . import ndarray as _nd_mod
 from . import profiler as _prof
 from .kvstore import KVStore, _key_list, _val_list
+from .telemetry import tracer as _tracer
 
 __all__ = ["CommEngine", "AsyncKVStore", "CommMetrics", "make_async",
            "maybe_async"]
@@ -134,7 +135,7 @@ class CommMetrics:
 # ---------------------------------------------------------------------------
 class _Op:
     __slots__ = ("fn", "keys", "priority", "seq", "label", "nleft",
-                 "event", "exc", "cleanup")
+                 "event", "exc", "cleanup", "flow_id")
 
     def __init__(self, fn, keys, priority, seq, label, cleanup):
         self.fn = fn
@@ -146,6 +147,7 @@ class _Op:
         self.nleft = 0            # chains where a predecessor still runs
         self.event = threading.Event()
         self.exc = None
+        self.flow_id = None       # trace flow linking submit -> execute
 
 
 class CommEngine:
@@ -198,6 +200,11 @@ class CommEngine:
             if op.nleft == 0:
                 heapq.heappush(self._ready, (-op.priority, op.seq, op))
                 self._ready_cv.notify()
+        if _tracer.active():
+            # flow arrow from the submitting thread to the worker-thread
+            # span executing the op (the finish lands in _worker)
+            op.flow_id = "comm-%d-%d" % (os.getpid(), op.seq)
+            _tracer.flow_event(op.label or "comm.op", "s", op.flow_id)
         return op
 
     def outstanding(self):
@@ -215,6 +222,9 @@ class CommEngine:
                 _, _, op = heapq.heappop(self._ready)
             try:
                 with _prof.Frame(op.label or "comm.op", "comm"):
+                    if op.flow_id is not None:
+                        _tracer.flow_event(op.label or "comm.op", "f",
+                                           op.flow_id)
                     op.fn()
             except BaseException as e:  # recorded, raised at the barrier
                 op.exc = e

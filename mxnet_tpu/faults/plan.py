@@ -201,6 +201,14 @@ class FaultPlan:
                     "injected I/O error at %s (call #%d, seed %d)"
                     % (op, n, self.seed))
             elif rule.kind == "kill":
+                try:
+                    # flight recorder: leave postmortem evidence of the
+                    # victim's last spans/events before the hard exit
+                    from .. import telemetry as _tm
+
+                    _tm.flight_recorder.dump("fault-kill:%s" % op)
+                except Exception:
+                    pass
                 os._exit(137)
             # 'partial' intentionally inert in fire()
 

@@ -48,7 +48,9 @@ from . import ndarray as nd
 from . import optimizer as opt
 from . import profiler as _prof
 from . import telemetry as _telemetry
+from .base import env as _env
 from .base import register_env
+from .telemetry import tracer
 
 __all__ = ["KVStoreServer", "start_server", "ServerClient",
            "KVStoreConnectionError", "_init_kvstore_server_module"]
@@ -85,6 +87,13 @@ register_env("MXNET_KVSTORE_SNAPSHOT_INTERVAL", 30, float,
 register_env("MXNET_KVSTORE_DEDUP_WINDOW", 4096, int,
              "Completed idempotency records kept per client for replay "
              "matching on the pipelined transport.")
+register_env("MXNET_TELEMETRY_STRAGGLER_MULT", 4.0, float,
+             "Flag a rank as a straggler when its sync-round merge "
+             "latency exceeds this multiple of the round median "
+             "(<= 0 disables detection).")
+register_env("MXNET_TELEMETRY_STRAGGLER_MIN_MS", 50.0, float,
+             "Minimum absolute sync-round latency (ms) before a rank can "
+             "be flagged as a straggler — suppresses noise on fast rounds.")
 
 
 # -- retry/backoff knobs (docs/how_to/fault_tolerance.md) -------------------
@@ -240,8 +249,44 @@ def _srv_metrics():
                 "mxtpu_kvsrv_evictions_total",
                 "Ranks evicted for heartbeat staleness (or by the evict "
                 "RPC)."),
+            "stragglers": reg.labeled_counter(
+                "mxtpu_kvsrv_stragglers_total", "rank",
+                "Sync-round contributions slower than "
+                "MXNET_TELEMETRY_STRAGGLER_MULT x the round median."),
+            "round_skew": reg.gauge(
+                "mxtpu_kvsrv_round_skew_ms",
+                "Last sync-merge round's max-minus-median contribution "
+                "wait (ms) — the fleet aggregator's skew source."),
+            # per-command latency histograms (incl. the membership RPCs
+            # join/leave/evict/membership) and per-rank round-wait
+            # histograms, created lazily as commands/ranks appear
+            "rpc_cmd_ms": {},
+            "rank_wait_ms": {},
         }
     return _TELEM
+
+
+def _cmd_hist(m, cmd):
+    h = m["rpc_cmd_ms"].get(cmd)
+    if h is None:
+        h = _telemetry.registry().histogram(
+            "mxtpu_kvsrv_rpc_%s_ms" % cmd,
+            "Server-side %r RPC dispatch latency (ms)." % cmd,
+            start=0.05, factor=4.0, count=10)
+        m["rpc_cmd_ms"][cmd] = h
+    return h
+
+
+def _rank_wait_hist(m, rank):
+    h = m["rank_wait_ms"].get(rank)
+    if h is None:
+        h = _telemetry.registry().histogram(
+            "mxtpu_kvsrv_round_wait_rank%s_ms" % rank,
+            "Rank %s's sync-merge contribution wait behind the round's "
+            "first arrival (ms)." % rank,
+            start=0.5, factor=4.0, count=10)
+        m["rank_wait_ms"][rank] = h
+    return h
 
 
 class KVStoreServer:
@@ -285,6 +330,12 @@ class KVStoreServer:
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
         self._merge: Dict[object, list] = {}
+        # telemetry-only shadow of _merge: per-round {rank: arrival ts}
+        # for straggler detection.  A PARALLEL structure because snapshot
+        # v3 pickles the _merge round dicts directly — timestamps must
+        # never leak into the durable format (and are meaningless across
+        # a restart's monotonic clock anyway).
+        self._merge_ts: Dict[object, list] = {}
         self._stop = threading.Event()
         # elastic membership (docs/how_to/fault_tolerance.md §elasticity):
         # the live-rank set replaces the static num_workers in barriers
@@ -351,25 +402,31 @@ class KVStoreServer:
                         msg = _recv_msg(sock, op="kv.server.recv")
                         if isinstance(msg, tuple) and msg and \
                                 msg[0] == "req":
-                            _, cid, seq, inner = msg
+                            # tolerate the 5-element envelope: slot 4 is
+                            # the optional distributed-trace context a
+                            # telemetry-enabled client stamps on
+                            cid, seq, inner = msg[1], msg[2], msg[3]
+                            ctx = msg[4] if len(msg) > 4 else None
                             wrapped = True
                         else:
-                            cid, seq, inner = None, None, msg
+                            cid, seq, inner, ctx = None, None, msg, None
                             wrapped = False
                         if wrapped and inner[0] == "barrier":
                             # a barrier parks for up to minutes; serve it
                             # off-thread so pipelined pushes/pulls behind
                             # it keep flowing on this connection
-                            def run(cid=cid, seq=seq, inner=inner):
+                            def run(cid=cid, seq=seq, inner=inner,
+                                    ctx=ctx):
                                 try:
                                     respond(True, seq, server_self.
-                                            _serve_one(cid, seq, inner))
+                                            _serve_one(cid, seq, inner,
+                                                       ctx))
                                 except (ConnectionError, OSError):
                                     pass
 
                             threading.Thread(target=run, daemon=True).start()
                             continue
-                        reply = server_self._serve_one(cid, seq, inner)
+                        reply = server_self._serve_one(cid, seq, inner, ctx)
                         respond(wrapped, seq, reply)
                         if inner[0] == "stop":
                             break
@@ -395,7 +452,7 @@ class KVStoreServer:
             self._evict_thread.start()
 
     # -- idempotent request admission --------------------------------------
-    def _serve_one(self, cid, seq, msg):
+    def _serve_one(self, cid, seq, msg, ctx=None):
         """Dispatch one request, deduplicating retries by (cid, seq).  A
         replayed token returns the recorded reply (waiting out a still-
         running original, e.g. a barrier whose connection died while
@@ -403,7 +460,7 @@ class KVStoreServer:
         many tokens in flight, so records live in a per-client window of
         completed seqs rather than a single newest-seq slot."""
         if cid is None:
-            return self._dispatch_timed(msg)
+            return self._dispatch_timed(msg, ctx)
         with self._dedup_cv:
             rec = self._dedup.setdefault(
                 cid, {"floor": 0, "window": OrderedDict()})
@@ -419,7 +476,7 @@ class KVStoreServer:
                         % (seq, rec["floor"], cid))
             ent = {"done": False, "reply": None}
             rec["window"][seq] = ent
-        reply = self._dispatch_timed(msg)
+        reply = self._dispatch_timed(msg, ctx)
         with self._dedup_cv:
             if rec["window"].get(seq) is ent:
                 ent["reply"] = reply
@@ -450,18 +507,33 @@ class KVStoreServer:
         except Exception as e:  # keep serving; tell the client
             return ("err", "%s: %s" % (type(e).__name__, e))
 
-    def _dispatch_timed(self, msg):
-        """_dispatch_safe plus telemetry: RPC latency histogram, per-command
-        counter, and a span on the merged trace.  Off path: one bool read,
-        then straight dispatch."""
+    def _dispatch_timed(self, msg, ctx=None):
+        """_dispatch_safe plus telemetry: RPC latency histograms (overall
+        AND per command — the membership RPCs join/leave/evict/membership
+        get their own series), per-command counter, and a span on the
+        merged trace carrying the envelope's distributed trace context so
+        the handler span shares the worker-side span's trace id.  Off
+        path: one bool read, then straight dispatch."""
         if not _telemetry.enabled():
             return self._dispatch_safe(msg)
         cmd = msg[0] if isinstance(msg, tuple) and msg else "?"
         m = _srv_metrics()
+        args = None
+        if ctx:
+            trace = ctx.get("trace")
+            args = {"trace": trace,
+                    "src": "%s%s" % (ctx.get("role", "?"),
+                                     ctx.get("rank", "?"))}
+            if trace is not None:
+                # finish the flow the client started: the merged fleet
+                # trace draws the arrow worker span -> this handler span
+                tracer.flow_event("kv.rpc", "f", trace)
         t0 = time.perf_counter()
-        with _prof.Frame("kv.rpc.%s" % cmd, "kvserver"):
+        with _prof.Frame("kv.rpc.%s" % cmd, "kvserver", args=args):
             reply = self._dispatch_safe(msg)
-        m["rpc_ms"].observe((time.perf_counter() - t0) * 1e3)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        m["rpc_ms"].observe(dur_ms)
+        _cmd_hist(m, cmd).observe(dur_ms)
         m["rpc_total"].inc(cmd)
         return reply
 
@@ -476,7 +548,15 @@ class KVStoreServer:
         if cmd == "multi":
             # fused bucket of inner commands (gradient coalescing): ONE
             # envelope = ONE dedup record, so exactly-once replay covers
-            # the whole bucket atomically from the client's perspective
+            # the whole bucket atomically from the client's perspective.
+            # Inner commands bypass _dispatch_timed (the bucket already
+            # owns the RPC span/histogram), so count them here — per-cmd
+            # totals must not lose the fused pushes/pulls.
+            if _telemetry.enabled():
+                counts = _srv_metrics()["rpc_total"]
+                for im in msg[1]:
+                    counts.inc(im[0] if isinstance(im, tuple) and im
+                               else "?")
             return ("ok", [self._dispatch_safe(m) for m in msg[1]])
         if cmd == "push":
             key, arr = msg[1], msg[2]
@@ -502,14 +582,23 @@ class KVStoreServer:
                     # (kvstore_dist_server.h:164-179 merges one push per
                     # worker before the update fires)
                     rounds = self._merge.setdefault(key, [])
-                    placed = False
-                    for rnd in rounds:
+                    placed_at = None
+                    for i, rnd in enumerate(rounds):
                         if rank not in rnd:
                             rnd[rank] = np.asarray(arr)
-                            placed = True
+                            placed_at = i
                             break
-                    if not placed:
+                    if placed_at is None:
                         rounds.append({rank: np.asarray(arr)})
+                        placed_at = len(rounds) - 1
+                    if _telemetry.enabled():
+                        # arrival timestamp for straggler detection,
+                        # mirrored in the shadow structure (never the
+                        # snapshotted round dicts)
+                        tss = self._merge_ts.setdefault(key, [])
+                        while len(tss) <= placed_at:
+                            tss.append({})
+                        tss[placed_at][rank] = time.monotonic()
                     self._flush_rounds_locked(key)
                 else:
                     self._apply(key, np.asarray(arr))
@@ -682,6 +771,8 @@ class KVStoreServer:
         rounds = self._merge.get(key)
         while rounds and self._round_complete_locked(rounds[0]):
             rnd = rounds.pop(0)
+            tss = self._merge_ts.get(key)
+            self._note_round_latency(key, tss.pop(0) if tss else None)
             self.round_sizes[len(rnd)] = self.round_sizes.get(len(rnd), 0) + 1
             merged = np.sum(list(rnd.values()), axis=0)
             if self._members and len(rnd) != self.num_workers:
@@ -689,6 +780,36 @@ class KVStoreServer:
                     merged * (float(self.num_workers) / len(rnd)),
                     dtype=merged.dtype)
             self._apply(key, merged)
+
+    def _note_round_latency(self, key, tsr):
+        """Straggler detection over one completed sync-merge round
+        (caller holds ``_lock``): per-rank round-wait histograms, the
+        round's max-minus-median skew gauge, and — when a rank's wait
+        behind the first arrival exceeds ``MXNET_TELEMETRY_STRAGGLER_MULT``
+        times the round median (and ``..._MIN_MS``, the noise floor) — a
+        structured ``straggler`` event plus a rank-labeled counter."""
+        if tsr is None or len(tsr) < 2 or not _telemetry.enabled():
+            return
+        import statistics
+
+        t0 = min(tsr.values())
+        lats = {r: (t - t0) * 1e3 for r, t in tsr.items()}
+        med = statistics.median(lats.values())
+        m = _srv_metrics()
+        for r, lat in lats.items():
+            _rank_wait_hist(m, r).observe(lat)
+        m["round_skew"].set(max(lats.values()) - med)
+        mult = _env("MXNET_TELEMETRY_STRAGGLER_MULT", 4.0, float)
+        if mult <= 0:
+            return
+        min_ms = _env("MXNET_TELEMETRY_STRAGGLER_MIN_MS", 50.0, float)
+        for r, lat in sorted(lats.items()):
+            if lat >= min_ms and lat > mult * max(med, 1e-9):
+                m["stragglers"].inc(str(r))
+                _telemetry.log_event(
+                    "straggler", key=str(key), rank=r,
+                    lat_ms=round(lat, 3), median_ms=round(med, 3),
+                    mult=mult, round_size=len(tsr))
 
     def _try_release_barrier_locked(self):
         """Release the parked barrier if every required rank has arrived
@@ -740,6 +861,10 @@ class KVStoreServer:
                     for rnd in rounds:
                         for r in gone:
                             rnd.pop(r, None)
+                for tss in self._merge_ts.values():
+                    for tsr in tss:
+                        for r in gone:
+                            tsr.pop(r, None)
                 for key in list(self._merge):
                     self._flush_rounds_locked(key)
             gen = self._mgen
@@ -755,6 +880,14 @@ class KVStoreServer:
                     r, gen, ranks_now, reason=reason)
             logging.info("kvstore membership: %s — rank(s) %s removed "
                          "(gen %d, live %s)", reason, gone, gen, ranks_now)
+            if reason != "leave" and _telemetry.enabled():
+                # an eviction is a death the victim could not report —
+                # the server's flight recorder keeps the evidence (round
+                # state, membership events, recent spans)
+                _telemetry.flight_recorder.dump(
+                    "evict:%s" % reason,
+                    extra={"evicted": gone, "gen": gen,
+                           "live": ranks_now})
         return gen
 
     def _note_membership(self, kind, rank, gen, ranks, reason=None):
@@ -1040,16 +1173,21 @@ class ServerClient:
             except OSError:
                 pass
 
-    def _submit(self, msg, retries=None):
+    def _submit(self, msg, retries=None, ctx=None):
         """Register an in-flight entry and send its envelope; returns the
         entry whose ``event`` fires when the reply (or failure) lands.
-        Non-blocking beyond the socket write — the pipelining primitive."""
+        Non-blocking beyond the socket write — the pipelining primitive.
+        ``ctx`` (telemetry on only) rides as an optional 5th envelope
+        element: the distributed trace context the server's handler span
+        adopts; replays reuse it, so a retried RPC keeps its trace id."""
         with self._state_cv:
             if self._closed:
                 raise ConnectionError("ServerClient is closed")
             self._seq += 1
             seq = self._seq
-            ent = {"seq": seq, "env": ("req", self._cid, seq, msg),
+            env = ("req", self._cid, seq, msg) if ctx is None \
+                else ("req", self._cid, seq, msg, ctx)
+            ent = {"seq": seq, "env": env,
                    "event": threading.Event(), "reply": None, "exc": None,
                    "retries": retries, "replays": 0}
             self._inflight[seq] = ent
@@ -1176,8 +1314,21 @@ class ServerClient:
     def _rpc(self, *msg, **kw):
         if self._closed:
             raise ConnectionError("ServerClient is closed")
-        ent = self._submit(msg, retries=kw.get("retries"))
-        ent["event"].wait()
+        if not _telemetry.enabled():
+            # hot path: one bool read, the 4-element envelope, no spans
+            ent = self._submit(msg, retries=kw.get("retries"))
+            ent["event"].wait()
+        else:
+            # distributed tracing: stamp a trace context into the
+            # envelope, open a client-side span (covering the full round
+            # trip) carrying the same trace id, and start a flow the
+            # server-side handler span finishes
+            ctx = _telemetry.distributed.new_trace_ctx(self._cid[:8])
+            with _prof.Frame("kv.client.%s" % msg[0], "kvclient",
+                             args={"trace": ctx["trace"]}):
+                tracer.flow_event("kv.rpc", "s", ctx["trace"])
+                ent = self._submit(msg, retries=kw.get("retries"), ctx=ctx)
+                ent["event"].wait()
         if ent["exc"] is not None:
             raise ent["exc"]
         reply = ent["reply"]

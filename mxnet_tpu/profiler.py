@@ -95,9 +95,13 @@ class Frame:
     """Context manager recording one named span into the chrome trace (the
     python-level analogue of OprExecStat, profiler.h:20-42)."""
 
-    def __init__(self, name, category="python"):
+    def __init__(self, name, category="python", args=None):
         self.name = name
         self.category = category
+        # optional chrome-trace args payload (e.g. the distributed trace
+        # id a kvstore RPC envelope carried); read at exit so callers may
+        # attach fields while the span is open
+        self.args = args
 
     def __enter__(self):
         self._t0 = time.perf_counter_ns() // 1000
@@ -112,6 +116,8 @@ class Frame:
             tid = threading.get_ident()
             ev = {"name": self.name, "cat": self.category, "ph": "X",
                   "ts": self._t0, "dur": t1 - self._t0, "pid": 0, "tid": tid}
+            if self.args:
+                ev["args"] = dict(self.args)
             tname = threading.current_thread().name
             if _state["running"]:
                 with _state["lock"]:

@@ -31,7 +31,9 @@ import weakref
 from typing import Optional
 
 from ..base import env, register_env
-from . import tracer
+from . import distributed, flight_recorder, tracer
+from .distributed import (FleetAggregator, proc_identity, proc_label,
+                          start_pusher, stop_pusher)
 from .registry import (Counter, EventLog, Gauge, Histogram, LabeledCounter,
                        Registry)
 from .step_monitor import (RecompileWarning, StepMonitor, fused_cost_analysis,
@@ -45,6 +47,8 @@ __all__ = [
     "current_step_monitor", "Registry", "Counter", "Gauge", "Histogram",
     "LabeledCounter", "EventLog", "StepMonitor", "RecompileWarning",
     "peak_flops", "fused_cost_analysis", "lower_and_analyze",
+    "distributed", "flight_recorder", "FleetAggregator", "proc_identity",
+    "proc_label", "start_pusher", "stop_pusher",
 ]
 
 register_env("MXNET_TELEMETRY", 0, int,
@@ -136,16 +140,47 @@ def events(n=None):
     return event_log().tail(n) if _event_log is not None else []
 
 
+_atexit_hooked = False
+
+
+def _atexit_flush():
+    """Process-exit flush for cluster observability: land one final
+    metrics push on the fleet aggregator (short-lived workers would
+    otherwise miss the last interval) and, with MXNET_TELEMETRY_DIR set,
+    dump this process's trace to ``trace-<role><rank>.json`` so
+    ``tools/trace_merge.py`` can stitch the fleet timeline."""
+    if not _ENABLED:
+        return
+    distributed.push_once()
+    d = env("MXNET_TELEMETRY_DIR", "", str)
+    if d and tracer.active():
+        try:
+            os.makedirs(d, exist_ok=True)
+            dump_trace(os.path.join(
+                d, "trace-%s.json" % distributed.proc_label()))
+        except Exception:
+            pass
+
+
 def enable(trace: Optional[bool] = None) -> None:
     """Turn telemetry on in-process (the env-var path calls this at
     import).  ``trace`` overrides MXNET_TELEMETRY_TRACE."""
-    global _ENABLED
+    global _ENABLED, _atexit_hooked
     with _lock:
         _ENABLED = True
     if trace is None:
         trace = bool(env("MXNET_TELEMETRY_TRACE", 1, int))
     if trace:
         tracer.start(env("MXNET_TELEMETRY_TRACE_BUFFER", 65536, int))
+    # cluster-wide pieces: metrics pusher (only when an aggregator
+    # address is configured), crash flight recorder, exit-time flush
+    distributed.start_pusher()
+    flight_recorder.install_excepthooks()
+    if not _atexit_hooked:
+        import atexit
+
+        atexit.register(_atexit_flush)
+        _atexit_hooked = True
 
 
 def disable() -> None:
@@ -153,6 +188,8 @@ def disable() -> None:
     with _lock:
         _ENABLED = False
     tracer.stop()
+    distributed.stop_pusher()
+    flight_recorder.uninstall_excepthooks()
     if _event_log is not None:
         _event_log.close()
         _event_log = None

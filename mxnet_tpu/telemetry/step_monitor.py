@@ -280,4 +280,21 @@ class StepMonitor:
         mfu = rep["mfu"]
         if mfu is not None:
             rep["mfu"] = round(mfu, 4)
+        # cluster health: when a colocated kvstore server flagged slow
+        # ranks this process's summary names them (per-rank counts)
+        stragglers = self._straggler_counts()
+        if stragglers:
+            rep["stragglers"] = stragglers
         return rep
+
+    @staticmethod
+    def _straggler_counts():
+        import mxnet_tpu.telemetry as _tm
+
+        reg = _tm._registry  # only if the global registry already exists
+        if reg is None:
+            return None
+        c = reg.get("mxtpu_kvsrv_stragglers_total")
+        if c is None or not getattr(c, "value", 0):
+            return None
+        return {str(k): v for k, v in c.snapshot().items()}

@@ -13,13 +13,14 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
 from .. import profiler as _prof
 
 __all__ = ["start", "stop", "active", "events", "merged_trace",
-           "dump_trace", "validate_trace", "span"]
+           "dump_trace", "validate_trace", "span", "flow_event"]
 
 _lock = threading.Lock()
 _buf: Optional[deque] = None
@@ -60,6 +61,23 @@ def events() -> List[dict]:
     return list(buf) if buf is not None else []
 
 
+def flow_event(name, phase, flow_id):
+    """Record one flow event ("s" start / "f" finish) binding a span on
+    this thread to its counterpart across a thread or process boundary —
+    how a worker's kvstore RPC span links to the server-side handler
+    span in the merged fleet trace.  No-op unless tracing is active."""
+    buf = _buf
+    if buf is None:
+        return
+    ev = {"name": name, "cat": "flow", "ph": phase, "id": flow_id,
+          "ts": time.perf_counter_ns() // 1000, "pid": 0,
+          "tid": threading.get_ident()}
+    if phase == "f":
+        ev["bp"] = "e"  # bind to the enclosing slice's end
+    buf.append(ev)
+    _tnames.setdefault(ev["tid"], threading.current_thread().name)
+
+
 def span(name, category="telemetry"):
     """A named span on the merged timeline — records whenever the legacy
     profiler is running OR telemetry tracing is active (profiler.Frame
@@ -83,11 +101,18 @@ def merged_trace() -> dict:
             continue
         seen.add(id(ev))
         merged.append(ev)
+    # role/rank-qualified track names: dumps from different processes of
+    # one job carry identically-named threads (comm-worker-0 exists in
+    # every worker), so the process label keeps multi-process merges
+    # (tools/trace_merge.py) collision-free and readable
+    from .distributed import proc_label
+
+    label = proc_label()
     meta = [{"name": "process_name", "ph": "M", "pid": 0,
-             "args": {"name": "mxnet_tpu"}}]
+             "args": {"name": label}}]
     for tid in sorted(tnames):
         meta.append({"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
-                     "args": {"name": tnames[tid]}})
+                     "args": {"name": "%s/%s" % (label, tnames[tid])}})
     return {"traceEvents": meta + merged, "displayTimeUnit": "ms"}
 
 

@@ -505,3 +505,36 @@ def test_client_reconnects_through_server_socket_loss():
             np.testing.assert_array_equal(out, np.full(3, 4.0, np.float32))
     finally:
         srv.stop()
+
+
+def test_membership_rpcs_counted_and_timed_per_command():
+    """Telemetry labels every server RPC — including the elastic
+    membership commands — with a per-command counter sample and a
+    per-command latency histogram."""
+    from mxnet_tpu import telemetry
+
+    telemetry._reset_for_tests()
+    telemetry.enable(trace=False)
+    srv = kvs.start_server(num_workers=2)
+    try:
+        with kvs.ServerClient(*srv.addr) as c:
+            c.join(0)
+            c.join(1)
+            c.membership()
+            c.evict(1)
+            c.leave(0)
+            c.init("k", np.zeros(2, np.float32))
+            c.multi([("push", "k", np.ones(2, np.float32), 0),
+                     ("pull", "k")])
+        text = telemetry.render_prometheus()
+        for cmd, n in (("join", 2), ("membership", 1), ("evict", 1),
+                       ("leave", 1), ("init", 1), ("multi", 1)):
+            assert 'mxtpu_kvsrv_rpc_total{cmd="%s"} %d' % (cmd, n) in text
+            assert "mxtpu_kvsrv_rpc_%s_ms_count %d" % (cmd, n) in text
+        # the fused bucket's INNER commands are counted too (the bucket
+        # itself is one timed RPC)
+        assert 'mxtpu_kvsrv_rpc_total{cmd="push"} 1' in text
+        assert 'mxtpu_kvsrv_rpc_total{cmd="pull"} 1' in text
+    finally:
+        srv.stop()
+        telemetry._reset_for_tests()

@@ -424,3 +424,46 @@ def test_speedometer_without_telemetry():
     _fit_small(speedometer=spd)
     assert spd.last_speed is not None
     assert spd.last_data_wait_ms is None
+
+
+def test_disabled_overhead_distributed_two_workers():
+    """Satellite of the cluster-observability PR: the <2% disabled-cost
+    guard extended to a 2-worker kvstore exchange.  Off, the dist RPC
+    path adds exactly two gate reads per RPC (client _rpc + server
+    _dispatch_timed) and keeps the plain 4-element wire envelope."""
+    from mxnet_tpu import kvstore_server as kvs
+
+    assert not telemetry.enabled()
+    srv = kvs.start_server(num_workers=2)
+    clients = []
+    try:
+        host, port = srv.addr
+        clients = [kvs.ServerClient(host, port) for _ in range(2)]
+        clients[0].init("w", np.zeros(8, np.float32))
+        # structural check: no trace ctx rides the wire while off
+        ent = clients[0]._submit(("membership",))
+        ent["event"].wait()
+        assert len(ent["env"]) == 4
+
+        # measured per-RPC time across both workers, steady state
+        for c in clients:
+            c.push("w", np.ones(8, np.float32))
+            c.pull("w")
+        n = 50
+        t0 = time.perf_counter()
+        for _ in range(n):
+            for c in clients:
+                c.push("w", np.ones(8, np.float32))
+                c.pull("w")
+        per_rpc_s = (time.perf_counter() - t0) / (n * 4)
+
+        m = 200_000
+        per_gate_s = timeit.timeit(telemetry.enabled, number=m) / m
+        gates_per_rpc = 2  # client-side _rpc + server-side dispatch
+        assert per_gate_s * gates_per_rpc < 0.02 * per_rpc_s, \
+            "telemetry-off gate cost %.3fus x %d vs rpc %.1fus" % (
+                per_gate_s * 1e6, gates_per_rpc, per_rpc_s * 1e6)
+    finally:
+        for c in clients:
+            c.close()
+        srv.stop()

@@ -1,0 +1,387 @@
+"""Cluster-wide observability (docs/how_to/observability.md): distributed
+trace propagation through kvstore RPC envelopes, the fleet metrics
+aggregator, straggler detection on sync merge rounds, and the crash
+flight recorder."""
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.telemetry.distributed import FleetAggregator
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry._reset_for_tests()
+    yield
+    telemetry._reset_for_tests()
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# trace context propagation (in-process client/server pair)
+# ---------------------------------------------------------------------------
+def test_rpc_trace_ids_shared_between_client_and_server_spans():
+    telemetry.enable(trace=True)
+    from mxnet_tpu.kvstore_server import ServerClient, start_server
+
+    srv = start_server(port=0, num_workers=1)
+    try:
+        with ServerClient("127.0.0.1", srv.addr[1]) as cli:
+            cli.init("w", np.zeros(4, np.float32))
+            cli.push("w", np.ones(4, np.float32))
+            cli.pull("w")
+            cli.multi([("init", "a", np.ones(2, np.float32)),
+                       ("init", "b", np.ones(2, np.float32))])
+        evs = telemetry.tracer.events()
+        client = {e["args"]["trace"]: e["name"] for e in evs
+                  if e.get("cat") == "kvclient" and e.get("args")}
+        server = {e["args"]["trace"]: e["name"] for e in evs
+                  if e.get("cat") == "kvserver" and e.get("args")}
+        # every client RPC span's trace id shows up on a server handler
+        # span: init, push, pull, and the fused multi bucket
+        assert client and set(client) <= set(server)
+        assert "kv.client.multi" in client.values()
+        # server spans carry the caller identity
+        srcs = {e["args"].get("src") for e in evs
+                if e.get("cat") == "kvserver" and e.get("args")}
+        assert srcs and all(s for s in srcs)
+        # flow events pair up per trace id ("s" client side, "f" server)
+        flows = [e for e in evs if e.get("ph") in ("s", "f")]
+        per_id = {}
+        for e in flows:
+            per_id.setdefault(e["id"], set()).add(e["ph"])
+        assert any(v == {"s", "f"} for v in per_id.values())
+    finally:
+        srv.stop()
+
+
+def test_telemetry_off_keeps_plain_envelope():
+    from mxnet_tpu.kvstore_server import ServerClient, start_server
+
+    assert not telemetry.enabled()
+    srv = start_server(port=0, num_workers=1)
+    try:
+        with ServerClient("127.0.0.1", srv.addr[1]) as cli:
+            ent = cli._submit(("pull_part", "nope", 0, 1))
+            ent["event"].wait()
+            assert len(ent["env"]) == 4  # no ctx element on the wire
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# 2 workers + 1 server as real processes: traces merge into ONE timeline,
+# metrics federate into ONE endpoint
+# ---------------------------------------------------------------------------
+_WORKER_SRC = r"""
+import os, sys, time
+import numpy as np
+from mxnet_tpu import telemetry
+from mxnet_tpu.kvstore_server import ServerClient
+
+rank = int(os.environ["DMLC_WORKER_ID"])
+port = int(os.environ["DMLC_PS_ROOT_PORT"])
+with ServerClient("127.0.0.1", port) as cli:
+    cli.init("w", np.zeros(4, np.float32))
+    for _ in range(3):
+        cli.push("w", np.ones(4, np.float32))
+        cli.pull("w")
+    cli.multi([("init", "m%d" % rank, np.ones(2, np.float32))])
+telemetry.gauge("mxtpu_step_last_ms").set(5.0 + rank)
+telemetry.distributed.push_once()
+"""
+
+
+@pytest.mark.slow
+def test_fleet_trace_merge_and_metrics_aggregation(tmp_path):
+    port = _free_port()
+    agg = FleetAggregator()
+    agg.start()
+    base = dict(os.environ,
+                JAX_PLATFORMS="cpu",
+                PYTHONPATH=REPO + (os.pathsep + os.environ["PYTHONPATH"]
+                                   if os.environ.get("PYTHONPATH") else ""),
+                DMLC_PS_ROOT_URI="127.0.0.1",
+                DMLC_PS_ROOT_PORT=str(port),
+                DMLC_NUM_WORKER="2",
+                MXNET_TELEMETRY="1",
+                MXNET_TELEMETRY_DIR=str(tmp_path),
+                MXNET_TELEMETRY_AGG_ADDR=agg.addr,
+                MXNET_TELEMETRY_AGG_INTERVAL="0.2")
+    server = subprocess.Popen(
+        [sys.executable, "-c", "import mxnet_tpu"],
+        env=dict(base, DMLC_ROLE="server"), cwd=REPO)
+    workers = []
+    try:
+        for r in range(2):
+            workers.append(subprocess.Popen(
+                [sys.executable, "-c", _WORKER_SRC],
+                env=dict(base, DMLC_WORKER_ID=str(r)), cwd=REPO))
+        for w in workers:
+            assert w.wait(timeout=120) == 0
+        # -- fleet metrics: one page, all three processes, rank labels --
+        deadline = time.monotonic() + 30
+        page = ""
+        while time.monotonic() < deadline:
+            page = urllib.request.urlopen(
+                "http://%s/metrics" % agg.addr, timeout=5).read().decode()
+            if all(s in page for s in
+                   ('role="worker",rank="0"', 'role="worker",rank="1"',
+                    'role="server"', 'mxtpu_fleet_step_ms{stat="min"} 5')):
+                break
+            time.sleep(0.2)
+        assert 'role="worker",rank="0"' in page
+        assert 'role="worker",rank="1"' in page
+        assert 'role="server"' in page, page
+        assert 'mxtpu_fleet_step_ms{stat="min"} 5' in page
+        assert 'mxtpu_fleet_step_ms{stat="max"} 6' in page
+        assert "mxtpu_kvsrv_rpc_push_ms_count" in page
+        from mxnet_tpu.kvstore_server import ServerClient
+
+        with ServerClient("127.0.0.1", port) as cli:
+            cli.stop_server()
+        assert server.wait(timeout=60) == 0
+    finally:
+        for p in workers + [server]:
+            if p.poll() is None:
+                p.kill()
+        agg.stop()
+
+    # -- trace merge: worker + server dumps -> one validated timeline --
+    paths = sorted(glob.glob(str(tmp_path / "trace-*.json")))
+    names = {os.path.basename(p) for p in paths}
+    assert {"trace-worker0.json", "trace-worker1.json",
+            "trace-server0.json"} <= names, names
+    merged = str(tmp_path / "fleet.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_merge.py"),
+         "-o", merged] + paths,
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    payload = json.load(open(merged))
+    telemetry.validate_trace(payload)
+    evs = payload["traceEvents"]
+    procs = {e["args"]["name"]: e["pid"] for e in evs
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert {"worker0", "worker1", "server0"} <= set(procs)
+    # the acceptance bar: a worker push span and the server handler span
+    # share a trace id while living on DIFFERENT process tracks
+    linked = 0
+    for role in ("worker0", "worker1"):
+        cpid, spid = procs[role], procs["server0"]
+        ctraces = {e["args"]["trace"] for e in evs
+                   if e.get("pid") == cpid and e.get("cat") == "kvclient"
+                   and e.get("args") and e["name"] == "kv.client.push"}
+        straces = {e["args"]["trace"] for e in evs
+                   if e.get("pid") == spid and e.get("cat") == "kvserver"
+                   and e.get("args")}
+        linked += len(ctraces & straces)
+    assert linked > 0
+    # thread tracks are role/rank-prefixed, so they never collide
+    tnames = [e["args"]["name"] for e in evs
+              if e.get("ph") == "M" and e.get("name") == "thread_name"]
+    assert tnames and all("/" in n for n in tnames)
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+# ---------------------------------------------------------------------------
+def test_straggler_event_on_delayed_rank(monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_STRAGGLER_MULT", "1.5")
+    monkeypatch.setenv("MXNET_TELEMETRY_STRAGGLER_MIN_MS", "50")
+    telemetry.enable(trace=False)
+    from mxnet_tpu.kvstore_server import KVStoreServer
+
+    srv = KVStoreServer(port=0, num_workers=2, sync_mode=True)
+    try:
+        srv._dispatch(("init", "w", np.zeros(4, np.float32)))
+        srv._dispatch(("push", "w", np.ones(4, np.float32), 0))
+        time.sleep(0.25)
+        srv._dispatch(("push", "w", np.ones(4, np.float32), 1))
+        evs = [e for e in telemetry.events() if e["kind"] == "straggler"]
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev["rank"] == 1 and ev["key"] == "w"
+        assert ev["lat_ms"] > 1.5 * ev["median_ms"]
+        assert ev["round_size"] == 2
+        # a prompt round raises no new event
+        srv._dispatch(("push", "w", np.ones(4, np.float32), 0))
+        srv._dispatch(("push", "w", np.ones(4, np.float32), 1))
+        evs = [e for e in telemetry.events() if e["kind"] == "straggler"]
+        assert len(evs) == 1
+        text = telemetry.render_prometheus()
+        assert 'mxtpu_kvsrv_stragglers_total{rank="1"} 1' in text
+        assert "mxtpu_kvsrv_round_skew_ms" in text
+        # StepMonitor summaries surface the per-rank counts
+        mon = telemetry.StepMonitor(telemetry)
+        assert mon.report()["stragglers"] == {"1": 1}
+    finally:
+        srv._server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+def test_flight_recorder_dump_contents(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_POSTMORTEM_DIR", str(tmp_path))
+    telemetry.enable(trace=True)
+    with telemetry.span("doomed-step"):
+        pass
+    telemetry.log_event("last-words", detail=42)
+    telemetry.counter("mxtpu_doom_total").inc()
+    path = telemetry.flight_recorder.dump("unit-test", extra={"k": "v"})
+    assert path and os.path.exists(path)
+    assert telemetry.flight_recorder.last_path() == path
+    post = json.load(open(path))
+    assert post["reason"] == "unit-test"
+    assert post["extra"] == {"k": "v"}
+    assert post["pid"] == os.getpid()
+    assert any(s["name"] == "doomed-step" for s in post["spans"])
+    assert any(e["kind"] == "last-words" for e in post["events"])
+    assert post["metrics"]["mxtpu_doom_total"] == 1
+
+
+def test_flight_recorder_noop_when_disabled(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_POSTMORTEM_DIR", str(tmp_path))
+    assert telemetry.flight_recorder.dump("nope") is None
+    assert not list(tmp_path.iterdir())
+
+
+def test_fault_kill_leaves_postmortem(tmp_path):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + (os.pathsep + os.environ["PYTHONPATH"]
+                                  if os.environ.get("PYTHONPATH") else ""),
+               MXNET_TELEMETRY="1",
+               MXNET_TELEMETRY_DIR=str(tmp_path),
+               MXNET_FAULTS_SPEC="boom.op:kill=1@#1",
+               MXNET_FAULTS_SEED="0")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from mxnet_tpu import faults, telemetry\n"
+         "with telemetry.span('pre-crash'):\n"
+         "    pass\n"
+         "faults.fire('boom.op')\n"],
+        env=env, cwd=REPO, timeout=120)
+    assert r.returncode == 137
+    pm = glob.glob(str(tmp_path / "postmortem-*.json"))
+    assert len(pm) == 1
+    post = json.load(open(pm[0]))
+    assert post["reason"] == "fault-kill:boom.op"
+    assert any(s["name"] == "pre-crash" for s in post["spans"])
+
+
+def test_preemption_handler_dumps_postmortem(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_POSTMORTEM_DIR", str(tmp_path))
+    telemetry.enable(trace=False)
+    from mxnet_tpu.kvstore import install_preemption_handler
+
+    calls = []
+
+    class _KV:
+        def drain(self, timeout=None):
+            calls.append("drain")
+            return True
+
+        def leave(self):
+            calls.append("leave")
+
+    handler = install_preemption_handler(_KV(), exit_process=False)
+    handler()
+    assert calls == ["drain", "leave"]
+    pm = glob.glob(str(tmp_path / "postmortem-*.json"))
+    assert len(pm) == 1
+    assert json.load(open(pm[0]))["reason"] == "preemption-sigterm"
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_excepthook_dump_on_unhandled_thread_exception(tmp_path,
+                                                       monkeypatch):
+    import threading
+
+    monkeypatch.setenv("MXNET_TELEMETRY_POSTMORTEM_DIR", str(tmp_path))
+    telemetry.enable(trace=False)
+
+    def boom():
+        raise RuntimeError("thread went sideways")
+
+    t = threading.Thread(target=boom, name="doomed-thread")
+    t.start()
+    t.join()
+    pm = glob.glob(str(tmp_path / "postmortem-*.json"))
+    assert len(pm) == 1
+    post = json.load(open(pm[0]))
+    assert post["reason"] == "thread-exception:RuntimeError"
+    assert post["extra"]["thread"] == "doomed-thread"
+
+
+# ---------------------------------------------------------------------------
+# aggregator unit surface
+# ---------------------------------------------------------------------------
+def test_aggregator_relabels_and_derives_fleet_gauges():
+    agg = FleetAggregator()
+    agg.start()
+    try:
+        def push(role, rank, body):
+            req = urllib.request.Request(
+                "http://%s/push?role=%s&rank=%d" % (agg.addr, role, rank),
+                data=body.encode(), method="POST")
+            urllib.request.urlopen(req, timeout=5).close()
+
+        push("worker", 0, "mxtpu_step_last_ms 5\nmxtpu_x_total{k=\"a\"} 2\n")
+        push("worker", 1, "mxtpu_step_last_ms 9\n")
+        push("server", 0, "mxtpu_kvsrv_round_skew_ms 3.5\n")
+        page = urllib.request.urlopen(
+            "http://%s/metrics" % agg.addr, timeout=5).read().decode()
+        assert 'mxtpu_step_last_ms{role="worker",rank="0"} 5' in page
+        assert 'mxtpu_step_last_ms{role="worker",rank="1"} 9' in page
+        # existing labels merge with the federation labels
+        assert 'mxtpu_x_total{k="a",role="worker",rank="0"} 2' in page
+        assert "mxtpu_fleet_processes 3" in page
+        assert 'mxtpu_fleet_step_ms{stat="min"} 5' in page
+        assert 'mxtpu_fleet_step_ms{stat="median"} 7' in page
+        assert 'mxtpu_fleet_step_ms{stat="max"} 9' in page
+        assert "mxtpu_fleet_sync_skew_ms 3.5" in page
+        health = json.loads(urllib.request.urlopen(
+            "http://%s/healthz" % agg.addr, timeout=5).read().decode())
+        assert health == {"status": "ok", "processes": 3}
+        assert agg.processes() == [("server", "0"), ("worker", "0"),
+                                   ("worker", "1")]
+    finally:
+        agg.stop()
+
+
+def test_proc_identity_follows_dmlc_contract(monkeypatch):
+    from mxnet_tpu.telemetry.distributed import proc_identity, proc_label
+
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    monkeypatch.setenv("DMLC_SERVER_ID", "2")
+    assert proc_identity() == ("server", 2)
+    assert proc_label() == "server2"
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    monkeypatch.setenv("DMLC_WORKER_ID", "1")
+    assert proc_identity() == ("worker", 1)
+    monkeypatch.delenv("DMLC_ROLE")
+    assert proc_identity() == ("worker", 1)  # DMLC_WORKER_ID fallback
+    monkeypatch.setenv("MXNET_TELEMETRY_ROLE", "evaluator")
+    assert proc_identity()[0] == "evaluator"

@@ -36,12 +36,32 @@ def emit(name, **kw):
           flush=True)
 
 
+def emit_partial(reason):
+    """A dead/flapping tunnel must still leave a machine-readable BENCH
+    record: everything measured so far is already on stdout (one line per
+    phase), so this marks the run explicitly incomplete — with whatever
+    telemetry summary the process accumulated — instead of leaving a
+    truncated log a reader has to diagnose."""
+    summary = None
+    try:
+        from mxnet_tpu import telemetry
+
+        summary = telemetry.summary() or None
+    except Exception:
+        pass
+    emit("partial", reason=reason, telemetry=summary)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-resnet", action="store_true")
     cli = ap.parse_args()
 
-    watchdog = threading.Timer(480, lambda: os._exit(3))
+    def _watchdog_fire():
+        emit_partial("backend init watchdog fired (480s): tunnel dead")
+        os._exit(3)
+
+    watchdog = threading.Timer(480, _watchdog_fire)
     watchdog.daemon = True
     watchdog.start()
 
@@ -55,12 +75,14 @@ def main():
     emit("probe", backend=backend,
          device=str(jax.devices()[0]))
     if backend != "tpu":
+        emit_partial("backend %s is not tpu" % backend)
         emit("abort", reason="backend %s is not tpu" % backend)
         return 2
 
     import bench
 
     peak = 197e12
+    errors = []
     try:
         lm = bench.transformer_lm_bench(attn_impl="flash")
         emit("transformer_lm_flash",
@@ -68,6 +90,7 @@ def main():
              tflops=round(lm["model_tflops"], 2),
              mfu=round(lm["model_tflops"] * 1e12 / peak, 4))
     except Exception as e:
+        errors.append("transformer_lm_flash")
         emit("transformer_lm_flash", error=str(e)[:200])
 
     from bench_attention import run_bench, run_oracle_bench
@@ -83,11 +106,13 @@ def main():
             emit(name, tflops=r["value"], mfu=r["mfu"],
                  step_ms=r["step_ms"])
         except Exception as e:
+            errors.append(name)
             emit(name, error=str(e)[:200])
     try:
         orc = run_oracle_bench(seq=8192, steps=5)
         emit("splash_oracle", tflops=orc["value"], mfu=orc["mfu"])
     except Exception as e:
+        errors.append("splash_oracle")
         emit("splash_oracle", error=str(e)[:200])
 
     if not cli.skip_resnet:
@@ -96,8 +121,13 @@ def main():
             emit("resnet50", **{k: v for k, v in rn.items()
                                 if k != "metric"})
         except Exception as e:
+            errors.append("resnet50")
             emit("resnet50", error=str(e)[:300])
-    emit("done")
+    if errors:
+        # some phases died (usually the tunnel flapping mid-window): the
+        # record set is explicitly partial, not a clean capture
+        emit_partial("phase error(s): %s" % ", ".join(errors))
+    emit("done", complete=not errors)
     return 0
 
 

@@ -61,7 +61,10 @@ def run_membership_churn(seed, timeout=120.0, workers=3, steps=10,
     again.  Returns True when the victim died with rc 137, membership
     shrank and grew back, and every survivor landed on the
     churn-invariant final weight."""
+    import glob
     import json
+    import shutil
+    import tempfile
     import time
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -73,12 +76,17 @@ def run_membership_churn(seed, timeout=120.0, workers=3, steps=10,
     victim = seed % workers
     kill_call = 2 + seed % max(1, join_step - 2)  # 1-based fire() count
     spec = "churn.worker.step:kill=1@#%d" % kill_call
+    # flight recorder: the hard-killed victim must leave postmortem
+    # evidence (its last spans/events) in this run-scoped directory
+    telem_dir = tempfile.mkdtemp(prefix="chaos-telemetry-")
     base = dict(os.environ,
                 DMLC_PS_ROOT_URI="127.0.0.1",
                 DMLC_PS_ROOT_PORT=str(port),
                 DMLC_NUM_WORKER=str(workers),
                 MXNET_KVSTORE_ELASTIC="1",
                 MXNET_KVSTORE_HEARTBEAT_INTERVAL="0.2",
+                MXNET_TELEMETRY="1",
+                MXNET_TELEMETRY_DIR=telem_dir,
                 CHURN_TOTAL_STEPS=str(steps),
                 CHURN_JOIN_STEP=str(join_step),
                 CHURN_EXPECT_MEMBERS=str(workers),
@@ -176,6 +184,33 @@ def run_membership_churn(seed, timeout=120.0, workers=3, steps=10,
                   % (r, info["final"], info["target"]),
                   file=sys.stderr, flush=True)
             ok = False
+    # flight recorder: the fault-injected kill must have dumped the
+    # victim's last spans/events before os._exit(137)
+    pm = sorted(glob.glob(os.path.join(
+        telem_dir, "postmortem-worker%d-*.json" % victim)))
+    if not pm:
+        print("chaos_run: no flight-recorder postmortem for victim rank %d "
+              "in %s" % (victim, telem_dir), file=sys.stderr, flush=True)
+        ok = False
+    else:
+        with open(pm[-1]) as f:
+            post = json.load(f)
+        if not post.get("reason", "").startswith("fault-kill:") or \
+                not (post.get("spans") or post.get("events")):
+            print("chaos_run: victim postmortem %s lacks kill reason or "
+                  "span/event evidence" % pm[-1],
+                  file=sys.stderr, flush=True)
+            ok = False
+        else:
+            print("chaos_run: victim postmortem ok: %s (%d spans, %d "
+                  "events)" % (os.path.basename(pm[-1]),
+                               len(post["spans"]), len(post["events"])),
+                  file=sys.stderr, flush=True)
+    if ok:
+        shutil.rmtree(telem_dir, ignore_errors=True)
+    else:
+        print("chaos_run: telemetry artifacts kept at %s" % telem_dir,
+              file=sys.stderr, flush=True)
     return ok
 
 

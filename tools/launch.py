@@ -112,6 +112,12 @@ def main():
                              "times per worker (checkpoint-based fault "
                              "tolerance: the training script resumes via "
                              "mx.model.find_latest_checkpoint)")
+    parser.add_argument("--metrics-port", type=int, default=0, metavar="P",
+                        help="host a fleet metrics aggregator on this port: "
+                             "every process pushes its telemetry registry "
+                             "(MXNET_TELEMETRY_AGG_ADDR is exported) and "
+                             "GET /metrics serves one Prometheus page with "
+                             "role/rank labels plus fleet-derived gauges")
     parser.add_argument("--elastic", type=str, default=None,
                         metavar="MIN:MAX",
                         help="elastic membership: workers join the kvstore "
@@ -170,6 +176,22 @@ def main():
     for kv in args.env:
         k, _, v = kv.partition("=")
         base_env[k] = v
+
+    aggregator = None
+    if args.metrics_port:
+        # fleet metrics: the launcher hosts the aggregation endpoint so it
+        # outlives any single worker; processes push their registries to it
+        # (telemetry.distributed.start_pusher reads the exported address)
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from mxnet_tpu.telemetry.distributed import FleetAggregator
+
+        agg_host = _local_ip() if hosts is not None else "127.0.0.1"
+        aggregator = FleetAggregator(host=agg_host, port=args.metrics_port)
+        aggregator.start()
+        base_env["MXNET_TELEMETRY_AGG_ADDR"] = aggregator.addr
+        print("launch.py: fleet metrics at http://%s/metrics"
+              % aggregator.addr, file=sys.stderr, flush=True)
 
     def spawn(env, rank):
         if hosts is None:
@@ -313,6 +335,8 @@ def main():
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+        if aggregator is not None:
+            aggregator.stop()
     sys.exit(rc)
 
 

@@ -57,39 +57,60 @@ def cmd_events(cli):
     return 0
 
 
-def cmd_trace(cli):
-    from mxnet_tpu import telemetry
+def merge_traces(paths):
+    """Merge per-process chrome-trace dumps into ONE fleet timeline.
 
-    merged = []
-    for path in cli.files:
+    Each input file gets its own synthetic pid (its index), every event is
+    rewritten onto that pid, and each file's ``process_name`` metadata —
+    the role/rank label (``worker0``, ``server0``) the tracer stamped at
+    dump time — names the process track.  Flow events keep their ids
+    untouched, so a worker-side ``"s"`` and the server-side ``"f"`` with
+    the same distributed trace id draw an arrow ACROSS process tracks.
+    Returns the merged payload dict (raises on an unreadable input)."""
+    out = []
+    seen = set()
+    for pid, path in enumerate(paths):
         with open(path) as f:
             payload = json.load(f)
         evs = payload.get("traceEvents", payload) \
             if isinstance(payload, dict) else payload
         if not isinstance(evs, list):
-            print("%s: not a chrome-trace file" % path, file=sys.stderr)
-            return 1
-        merged.extend(evs)
-    # one metadata block wins per (pid, tid/name) — drop duplicates that
-    # appear when several dumps carry the same thread_name records
-    seen = set()
-    out = []
-    for ev in merged:
-        if ev.get("ph") == "M":
-            key = (ev.get("name"), ev.get("pid"), ev.get("tid"),
-                   json.dumps(ev.get("args", {}), sort_keys=True))
-            if key in seen:
+            raise ValueError("%s: not a chrome-trace file" % path)
+        for ev in evs:
+            if not isinstance(ev, dict):
                 continue
-            seen.add(key)
-        out.append(ev)
-    payload = {"traceEvents": out, "displayTimeUnit": "ms"}
+            ev = dict(ev)  # never mutate the loaded payload
+            ev["pid"] = pid
+            if ev.get("ph") == "M":
+                # per-process metadata dedup: one process_name per pid,
+                # one thread_name per (pid, tid)
+                key = (pid, ev.get("name"), ev.get("tid"),
+                       json.dumps(ev.get("args", {}), sort_keys=True))
+                if key in seen:
+                    continue
+                seen.add(key)
+            out.append(ev)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def cmd_trace(cli):
+    from mxnet_tpu import telemetry
+
+    try:
+        payload = merge_traces(cli.files)
+    except (ValueError, OSError) as e:
+        print(str(e), file=sys.stderr)
+        return 1
     telemetry.validate_trace(payload)
     with open(cli.output, "w") as f:
         json.dump(payload, f)
+    out = payload["traceEvents"]
     spans = sum(1 for e in out if e.get("ph") == "X")
     tids = {(e.get("pid"), e.get("tid")) for e in out if e.get("ph") == "X"}
-    print("wrote %s: %d span(s) across %d thread track(s)"
-          % (cli.output, spans, len(tids)))
+    procs = sorted(e["args"].get("name", "?") for e in out
+                   if e.get("ph") == "M" and e.get("name") == "process_name")
+    print("wrote %s: %d span(s) across %d thread track(s), processes: %s"
+          % (cli.output, spans, len(tids), ", ".join(procs) or "(none)"))
     return 0
 
 
