@@ -19,11 +19,32 @@ from typing import List, Optional
 from .base import env, register_env
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "pause", "resume", "Frame"]
+           "pause", "resume", "Frame", "trace_tid"]
 
 _state = {"mode": "symbolic", "filename": "profile.json", "running": False,
           "events": [], "tnames": {}, "jax_trace_dir": None,
           "lock": threading.Lock()}
+
+# Synthetic per-thread track ids.  ``threading.get_ident()`` values are
+# recycled by the OS the moment a thread exits, so in a long trace a fresh
+# worker (serving batcher, router pool, HTTP handler) can inherit a dead
+# comm-engine worker's ident and silently rename its track in the merged
+# thread_name metadata.  Handing every thread a monotonically increasing id
+# on first use keeps exactly one track per actual thread for the lifetime
+# of the process.
+_tid_local = threading.local()
+_tid_next = [1]
+
+
+def trace_tid() -> int:
+    """This thread's stable trace-track id (never reused across threads)."""
+    tid = getattr(_tid_local, "tid", None)
+    if tid is None:
+        with _state["lock"]:
+            tid = _tid_next[0]
+            _tid_next[0] += 1
+        _tid_local.tid = tid
+    return tid
 
 # external span sink installed by mxnet_tpu.telemetry.tracer: when set,
 # Frame/record_event deliver each event (plus the recording thread's name)
@@ -111,9 +132,9 @@ class Frame:
         sink = _sink
         if _state["running"] or sink is not None:
             t1 = time.perf_counter_ns() // 1000
-            # real thread id: spans from worker threads (comm engine,
+            # per-thread id: spans from worker threads (comm engine,
             # serving batcher, kvstore handlers) land on their own tracks
-            tid = threading.get_ident()
+            tid = trace_tid()
             ev = {"name": self.name, "cat": self.category, "ph": "X",
                   "ts": self._t0, "dur": t1 - self._t0, "pid": 0, "tid": tid}
             if self.args:
@@ -130,7 +151,7 @@ class Frame:
 def record_event(name, t0_us, dur_us, category="op"):
     sink = _sink
     if _state["running"] or sink is not None:
-        tid = threading.get_ident()
+        tid = trace_tid()
         ev = {"name": name, "cat": category, "ph": "X", "ts": t0_us,
               "dur": dur_us, "pid": 0, "tid": tid}
         tname = threading.current_thread().name

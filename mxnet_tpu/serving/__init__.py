@@ -5,14 +5,21 @@ micro-batcher that coalesces concurrent traffic into padded power-of-two
 bucket batches (pre-compiled at startup, so steady state never
 recompiles), a threaded front end with futures / bounded-queue
 backpressure / per-request deadlines / graceful drain, an optional
-stdlib-HTTP endpoint, and Prometheus-style metrics wired into the
-chrome-trace profiler.
+stdlib-HTTP endpoint, Prometheus-style metrics wired into the
+chrome-trace profiler — and, over N such replicas, a resilient
+:class:`Router` front door with health/load-aware dispatch, per-replica
+circuit breakers, bounded retry + hedging, per-SLO admission classes,
+and zero-downtime checkpoint hot-swap.
 """
 from .batcher import (BucketedPredictor, DeadlineExceededError, MicroBatcher,
                       QueueFullError, ServerClosedError, pow2_buckets)
 from .metrics import ServingMetrics
+from .router import (NoReplicaAvailableError, Router, RouterError,
+                     RouterMetrics, RouterOverloadError, SLOClass)
 from .server import InferenceServer
 
 __all__ = ["InferenceServer", "BucketedPredictor", "MicroBatcher",
            "ServingMetrics", "pow2_buckets", "QueueFullError",
-           "DeadlineExceededError", "ServerClosedError"]
+           "DeadlineExceededError", "ServerClosedError",
+           "Router", "SLOClass", "RouterMetrics", "RouterError",
+           "NoReplicaAvailableError", "RouterOverloadError"]

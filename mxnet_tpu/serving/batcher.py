@@ -91,6 +91,12 @@ class BucketedPredictor:
             self._preds[b] = base.reshape(
                 {k: (b,) + s for k, s in self.item_shapes.items()})
         self.executor_calls = 0
+        # compile-behaviour bookkeeping: buckets whose executable exists
+        # because warmup() ran them, and how many post-warmup flushes hit
+        # a bucket warmup never touched (the "steady state never
+        # recompiles" contract is exactly cold_runs == 0)
+        self.warmed_buckets = set()
+        self.cold_runs = 0
 
     @property
     def max_batch_size(self):
@@ -113,12 +119,16 @@ class BucketedPredictor:
             pred._exec.forward(is_train=False)
             for out in pred.get_outputs():
                 out.asnumpy()  # block until the compile+run finished
+            self.warmed_buckets.add(b)
 
     def forward_batch(self, items: List[Dict[str, np.ndarray]]):
         """Run one padded batch; returns per-item output lists (the batch
         axis is stripped from every output that carries one)."""
         n = len(items)
         b = self.bucket_for(n)
+        if b not in self.warmed_buckets:
+            self.cold_runs += 1
+            self.warmed_buckets.add(b)
         pred = self._preds[b]
         for name, shape in self.item_shapes.items():
             buf = np.zeros((b,) + shape, self._dtype)
@@ -171,9 +181,9 @@ class MicroBatcher:
         self._closed = False
         self._dead_workers: List[str] = []  # "name: exc" per crashed worker
         self._workers = [
-            threading.Thread(target=self._run, args=(rep,),
+            threading.Thread(target=self._run, args=(i,),
                              name="mxtpu-serving-%d" % i, daemon=True)
-            for i, rep in enumerate(replicas)]
+            for i in range(len(replicas))]
         self._started = False
 
     def start(self):
@@ -181,6 +191,20 @@ class MicroBatcher:
             self._started = True
             for w in self._workers:
                 w.start()
+
+    def swap_replicas(self, replicas: List[BucketedPredictor]):
+        """Atomically replace the predictor families the worker threads
+        execute on (the in-place checkpoint hot-swap).  Workers re-read
+        their replica slot at the top of every flush, so the batch in
+        flight finishes on the old weights and the very next flush runs
+        on the new ones — no queue teardown, no dropped work."""
+        if len(replicas) != len(self._replicas):
+            raise ValueError("swap must keep the replica count (%d != %d)"
+                             % (len(replicas), len(self._replicas)))
+        with self._cv:
+            self._replicas = list(replicas)
+            self.max_batch_size = min(r.max_batch_size for r in replicas)
+            self._cv.notify_all()
 
     def put(self, inputs, future, deadline=None):
         with self._cv:
@@ -251,11 +275,13 @@ class MicroBatcher:
             self._metrics.on_dequeue(len(self._q))
             return batch
 
-    def _run(self, replica):
+    def _run(self, slot):
         # _execute already confines per-batch executor failures to the
         # affected futures; anything escaping to here kills this replica's
         # thread, so record it — a fully-working-looking server with dead
-        # workers is exactly the failure mode /healthz must surface
+        # workers is exactly the failure mode /healthz must surface.
+        # The replica is re-read from its slot per flush so that
+        # swap_replicas() takes effect between batches.
         try:
             while True:
                 batch = self._collect()
@@ -263,7 +289,7 @@ class MicroBatcher:
                     return
                 if not batch:
                     continue
-                self._execute(replica, batch)
+                self._execute(self._replicas[slot], batch)
         except BaseException as exc:
             with self._cv:
                 self._dead_workers.append(

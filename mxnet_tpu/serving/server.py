@@ -74,8 +74,10 @@ class InferenceServer:
         if buckets is None:
             buckets = pow2_buckets(max_batch)
         self._item_shapes = {k: s[1:] for k, s in shapes.items()}
+        self._input_shapes = shapes
         self._dtype = np.dtype(dtype)
         ctxs = ctx if isinstance(ctx, (list, tuple)) else [ctx]
+        self._ctxs = list(ctxs)
         self._replicas = [
             BucketedPredictor(symbol, params, self._item_shapes, buckets,
                               ctx=c, dtype=dtype)
@@ -90,9 +92,18 @@ class InferenceServer:
             if max_queue is None else max_queue)
         self._httpd = None
         self._http_thread = None
+        # lifecycle for the liveness/readiness split: readiness is gated
+        # on started + warmed + not draining/stopped, liveness (healthz)
+        # keeps its worker-thread semantics untouched
+        self._started = False
+        self._draining = False
+        self._stopped = False
+        self._swap_lock = threading.Lock()
+        # warmup=False is an explicit opt-out (lazy compiles): the server
+        # counts as warmed-for-readiness the moment it starts
+        self._warmed = not warmup
         if warmup:
-            for rep in self._replicas:
-                rep.warmup()
+            self.warmup()
         if start:
             self.start()
 
@@ -107,12 +118,29 @@ class InferenceServer:
     # -- lifecycle --------------------------------------------------------
     def start(self):
         self._batcher.start()
+        self._started = True
+        return self
+
+    def warmup(self):
+        """Pre-compile every bucket on every replica.  The server is not
+        :meth:`ready` until this completes (callers deferring warmup past
+        construction get the ``/readyz`` 503-while-warming window)."""
+        self._warmed = False
+        for rep in self._replicas:
+            rep.warmup()
+        self._warmed = True
         return self
 
     def stop(self, drain: bool = True):
         """Stop the service.  With ``drain`` (default) queued requests are
         flushed before the workers exit; without it they fail fast with
-        :class:`ServerClosedError`.  Idempotent."""
+        :class:`ServerClosedError`.  Idempotent: a second ``stop`` (any
+        ``drain`` value) is a no-op rather than re-failing futures or
+        re-joining dead workers."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._draining = True
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -148,6 +176,8 @@ class InferenceServer:
         admission control rejects, ``ServerClosedError`` after ``stop``;
         the future raises ``DeadlineExceededError`` if ``deadline_ms``
         elapses while the request is still queued."""
+        if self._stopped:
+            raise ServerClosedError("server is stopped")
         missing = set(self._item_shapes) - set(inputs)
         if missing:
             raise MXNetError("missing inputs %s" % sorted(missing))
@@ -172,6 +202,83 @@ class InferenceServer:
         dead = self._batcher.dead_workers()
         return ("degraded" if dead else "ok", dead)
 
+    def ready(self) -> bool:
+        """Readiness (distinct from liveness): True only when the server
+        is started, warmed (or warmup was explicitly opted out), not
+        draining/stopped, and at least one replica worker survives.  A
+        router must never dispatch to a warming or draining replica —
+        that is this predicate, surfaced over HTTP as ``/readyz``."""
+        if not self._started or self._draining or self._stopped \
+                or not self._warmed:
+            return False
+        return len(self._batcher.dead_workers()) < len(self._replicas)
+
+    def ready_state(self) -> str:
+        """Why-not-ready detail for ``/readyz``: one of ``ready`` /
+        ``starting`` / ``warming`` / ``draining`` / ``stopped`` /
+        ``dead``."""
+        if self._stopped:
+            return "stopped"
+        if self._draining:
+            return "draining"
+        if not self._warmed:
+            return "warming"
+        if not self._started:
+            return "starting"
+        if len(self._batcher.dead_workers()) >= len(self._replicas):
+            return "dead"
+        return "ready"
+
+    def swap(self, prefix, epoch):
+        """In-place zero-downtime checkpoint hot-swap.
+
+        Builds a fresh shadow :class:`BucketedPredictor` family per
+        context from ``prefix-symbol.json`` / ``prefix-NNNN.params``,
+        warms **every** bucket on it (so post-swap steady state never
+        recompiles), then atomically flips the batcher onto the new
+        predictors.  The batch in flight finishes on the old weights;
+        the very next flush runs the new ones.  The server keeps
+        accepting and serving requests throughout — readiness never
+        drops.  Serialized: concurrent ``swap`` calls queue up."""
+        from .. import faults
+
+        faults.fire("serving.server.swap")
+        symbol = "%s-symbol.json" % prefix
+        params = "%s-%04d.params" % (prefix, epoch)
+        with self._swap_lock:
+            shadows = [
+                BucketedPredictor(symbol, params, self._item_shapes,
+                                  self.buckets, ctx=c, dtype=self._dtype)
+                for c in self._ctxs]
+            for rep in shadows:
+                rep.warmup()
+            self._batcher.swap_replicas(shadows)
+            self._replicas = shadows
+        from .. import telemetry as _tm
+
+        _tm.log_event("serving_swap", prefix=prefix, epoch=int(epoch),
+                      buckets=list(self.buckets))
+        return self
+
+    def swap_config(self) -> Dict:
+        """Constructor kwargs (minus the model) a router needs to build a
+        shadow server of this one — same shapes, buckets, batching knobs,
+        contexts, and dtype."""
+        return {
+            "input_shapes": dict(self._input_shapes),
+            "buckets": tuple(self.buckets),
+            "max_wait_us": self._batcher.max_wait_us,
+            "max_queue": self._batcher.max_queue,
+            "ctx": list(self._ctxs),
+            "dtype": self._dtype,
+        }
+
+    def cold_bucket_runs(self) -> int:
+        """Post-warmup flushes that hit a never-warmed bucket, summed
+        over replicas — the observable recompile counter for the
+        "steady state never recompiles" acceptance check."""
+        return sum(rep.cold_runs for rep in self._replicas)
+
     def metrics_text(self):
         return self.metrics.render_text()
 
@@ -182,13 +289,23 @@ class InferenceServer:
 
         * ``POST /predict`` — body ``{"inputs": {name: nested list},
           "deadline_ms": optional}`` → ``{"outputs": [...]}``; 503 when
-          the queue is full (retry with backoff), 504 past deadline.
+          the queue is full (retry with backoff), 504 past deadline.  An
+          ``X-Deadline-Ms`` request header sets the deadline too (the
+          body field wins when both are present).
+        * ``POST /swap`` — body ``{"prefix": ..., "epoch": N}``: in-place
+          warm checkpoint hot-swap (every bucket pre-compiled on the new
+          params before the atomic flip; serving never pauses).
         * ``GET /metrics`` — Prometheus text.
         * ``GET /healthz`` — liveness: 200 ``ok`` when every replica
           worker thread is alive; 503 with a JSON
           ``{"status": "degraded", "dead_workers": [...]}`` body when one
           has died (the server limps on through surviving replicas, but
           the orchestrator should recycle it).
+        * ``GET /readyz`` — readiness: 200 ``ready`` only when the server
+          should receive traffic; 503 ``{"status": "warming" | "draining"
+          | ...}`` while warming up, draining, or stopped, so a router
+          never routes to a warming/draining replica.  Liveness semantics
+          on ``/healthz`` are unchanged.
         """
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -217,17 +334,33 @@ class InferenceServer:
                     else:
                         self._reply(503, json.dumps(
                             {"status": "degraded", "dead_workers": dead}))
+                elif self.path == "/readyz":
+                    if server.ready():
+                        self._reply(200, "ready", ctype="text/plain")
+                    else:
+                        self._reply(503, json.dumps(
+                            {"status": server.ready_state()}))
                 else:
                     self._reply(404, json.dumps({"error": "not found"}))
 
             def do_POST(self):
-                if self.path != "/predict":
-                    self._reply(404, json.dumps({"error": "not found"}))
-                    return
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     req = json.loads(self.rfile.read(n) or b"{}")
-                    fut = server.submit(deadline_ms=req.get("deadline_ms"),
+                    if self.path == "/swap":
+                        server.swap(req["prefix"], int(req["epoch"]))
+                        self._reply(200, json.dumps(
+                            {"swapped": True, "epoch": int(req["epoch"])}))
+                        return
+                    if self.path != "/predict":
+                        self._reply(404, json.dumps({"error": "not found"}))
+                        return
+                    deadline_ms = req.get("deadline_ms")
+                    if deadline_ms is None:
+                        hdr = self.headers.get("X-Deadline-Ms")
+                        if hdr:
+                            deadline_ms = float(hdr)
+                    fut = server.submit(deadline_ms=deadline_ms,
                                         **req.get("inputs", {}))
                     outs = fut.result()
                     self._reply(200, json.dumps(
@@ -238,9 +371,9 @@ class InferenceServer:
                     self._reply(504, json.dumps({"error": str(exc)}))
                 except ServerClosedError as exc:
                     self._reply(503, json.dumps({"error": str(exc)}))
-                except (MXNetError, ValueError, TypeError,
-                        json.JSONDecodeError) as exc:
-                    self._reply(400, json.dumps({"error": str(exc)}))
+                except (MXNetError, ValueError, TypeError, KeyError,
+                        OSError, json.JSONDecodeError) as exc:
+                    self._reply(400, json.dumps({"error": repr(exc)}))
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._http_thread = threading.Thread(

@@ -71,7 +71,7 @@ def flow_event(name, phase, flow_id):
         return
     ev = {"name": name, "cat": "flow", "ph": phase, "id": flow_id,
           "ts": time.perf_counter_ns() // 1000, "pid": 0,
-          "tid": threading.get_ident()}
+          "tid": _prof.trace_tid()}
     if phase == "f":
         ev["bp"] = "e"  # bind to the enclosing slice's end
     buf.append(ev)

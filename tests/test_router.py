@@ -1,0 +1,401 @@
+"""Router tests — health/load-aware dispatch, circuit breakers, retries,
+hedging, SLO shedding, zero-downtime hot-swap, and the HTTP front door.
+CPU-only and fast; the chaos-marked tests drive the failure paths through
+a seeded FaultPlan so every failover decision is reproducible."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving
+
+
+IN_DIM = 6
+HID = 3
+
+
+def _tiny_model(seed=0):
+    rng = np.random.RandomState(seed)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=HID,
+                                name="fc")
+    params = {
+        "fc_weight": mx.nd.array(rng.randn(HID, IN_DIM).astype(np.float32)),
+        "fc_bias": mx.nd.array(rng.randn(HID).astype(np.float32)),
+    }
+    return net, params
+
+
+def _reference_outputs(net, params, X):
+    pred = mx.Predictor(net, dict(params), {"data": (1, IN_DIM)})
+    return np.stack([pred.forward(data=X[i:i + 1])[0].asnumpy()[0]
+                     for i in range(len(X))])
+
+
+def _servers(n=2, net=None, params=None, **kw):
+    if net is None:
+        net, params = _tiny_model()
+    kw.setdefault("max_wait_us", 1000)
+    kw.setdefault("warmup", False)
+    return net, params, [
+        serving.InferenceServer(net, dict(params), {"data": (4, IN_DIM)},
+                                **kw) for _ in range(n)]
+
+
+def test_router_dispatch_matches_reference():
+    """Requests fan out over two replicas and every answer matches the
+    single-Predictor reference — dispatch is a routing decision, never a
+    numerical one."""
+    net, params, srvs = _servers(2)
+    X = np.random.RandomState(1).randn(10, IN_DIM).astype(np.float32)
+    ref = _reference_outputs(net, params, X)
+    with serving.Router(srvs, seed=1) as router:
+        try:
+            futs = [router.submit(data=X[i]) for i in range(10)]
+            for i in range(10):
+                np.testing.assert_allclose(futs[i].result(timeout=60)[0],
+                                           ref[i], rtol=1e-5, atol=1e-6)
+            snap = router.metrics.snapshot()
+            assert snap["requests"] == {"interactive": 10}
+            assert snap["completed"] == {"interactive": 10}
+            assert snap["failed"] == {}
+            assert router.metrics.latency_quantile(0.5) > 0
+            # both replicas took traffic (p2c over equal-score replicas)
+            assert sum(d["calls"] for d in router.describe()) == 10
+        finally:
+            router.close(stop_backends=True)
+
+
+def test_router_validates_inputs():
+    net, params, srvs = _servers(1)
+    router = serving.Router(srvs)
+    try:
+        with pytest.raises(mx.MXNetError):
+            router.submit(slo="no-such-class", data=np.zeros(IN_DIM))
+        with pytest.raises(ValueError):
+            serving.Router([])
+    finally:
+        router.close(stop_backends=True)
+    with pytest.raises(serving.ServerClosedError):
+        router.submit(data=np.zeros(IN_DIM, np.float32))
+    router.close()  # idempotent
+
+
+@pytest.mark.chaos
+def test_failover_zero_failed_requests_and_breaker_recovery():
+    """The acceptance scenario: fault-inject hard failures on one replica
+    mid-load.  Every client request still succeeds (bounded retry onto
+    the healthy replica), the sick replica's breaker opens after the
+    failure threshold, and once the fault clears the breaker walks
+    open -> half-open -> closed on a probe request."""
+    net, params, srvs = _servers(2)
+    X = np.random.RandomState(2).randn(12, IN_DIM).astype(np.float32)
+    ref = _reference_outputs(net, params, X)
+    router = serving.Router(srvs, seed=3, retries=2, breaker_threshold=3,
+                            breaker_cooldown_ms=80)
+    try:
+        with mx.faults.inject("serving.replica.r1.call:ioerr=1", seed=7):
+            for i in range(12):
+                out = router.predict(data=X[i])
+                np.testing.assert_allclose(out[0], ref[i], rtol=1e-5,
+                                           atol=1e-6)
+        snap = router.metrics.snapshot()
+        assert snap["failed"] == {}          # zero failed client requests
+        assert snap["completed"] == {"interactive": 12}
+        assert snap["retries"] >= 3          # each r1 failure failed over
+        assert snap["replica_failures"]["r1"] >= 3
+        assert snap["breaker_transitions"]["open"] >= 1
+        states = {d["name"]: d["state"] for d in router.describe()}
+        assert states["r1"] == serving.router.BREAKER_OPEN
+        assert states["r0"] == serving.router.BREAKER_CLOSED
+
+        # fault cleared: after the cooldown the next pick admits one
+        # half-open probe through r1, which succeeds and re-closes it
+        time.sleep(0.1)
+        for i in range(6):
+            router.predict(data=X[i])
+        snap = router.metrics.snapshot()
+        assert snap["failed"] == {}
+        assert snap["breaker_transitions"]["half_open"] >= 1
+        assert snap["breaker_transitions"]["closed"] >= 1
+        states = {d["name"]: d["state"] for d in router.describe()}
+        assert states["r1"] == serving.router.BREAKER_CLOSED
+    finally:
+        router.close(stop_backends=True)
+
+
+@pytest.mark.chaos
+def test_all_replicas_down_is_a_typed_503():
+    net, params, srvs = _servers(1)
+    router = serving.Router(srvs, seed=0, retries=2, breaker_threshold=1)
+    try:
+        with mx.faults.inject("serving.replica.*.call:ioerr=1", seed=1):
+            fut = router.submit(data=np.zeros(IN_DIM, np.float32))
+            with pytest.raises(serving.NoReplicaAvailableError):
+                fut.result(timeout=30)
+        assert router.metrics.snapshot()["failed"] == {"interactive": 1}
+    finally:
+        router.close(stop_backends=True)
+
+
+@pytest.mark.chaos
+def test_hedged_requests_cut_the_tail():
+    """With a fixed hedge delay, a call stuck on an injected-slow replica
+    is duplicated onto the other one and the fast answer wins — the
+    client sees the hedge delay, not the slow replica's latency."""
+    net, params, srvs = _servers(2)
+    X = np.random.RandomState(4).randn(6, IN_DIM).astype(np.float32)
+    ref = _reference_outputs(net, params, X)
+    router = serving.Router(srvs, seed=5, hedge_ms=40)
+    try:
+        with mx.faults.inject("serving.replica.r0.call:delay=1@300ms",
+                              seed=2):
+            t0 = time.monotonic()
+            for i in range(6):
+                out = router.predict(data=X[i])
+                np.testing.assert_allclose(out[0], ref[i], rtol=1e-5,
+                                           atol=1e-6)
+            elapsed = time.monotonic() - t0
+        snap = router.metrics.snapshot()
+        assert snap["failed"] == {}
+        assert snap["hedges"] >= 1
+        assert snap["hedge_wins"] >= 1
+        # 6 un-hedged calls through the slow replica would take >= 1.8s
+        assert elapsed < 1.8
+    finally:
+        router.close(stop_backends=True)
+
+
+def test_slo_shedding_under_pressure():
+    """Admission control sheds the sheddable class (429-with-Retry-After
+    semantics) while interactive traffic keeps flowing."""
+    net, params, srvs = _servers(1)
+    router = serving.Router(srvs, shed_pressure=0.75)
+    try:
+        router.pressure = lambda: 0.9  # saturate the load signal
+        with pytest.raises(serving.RouterOverloadError) as err:
+            router.submit(slo="batch", data=np.zeros(IN_DIM, np.float32))
+        assert err.value.retry_after > 0
+        # interactive is non-sheddable: admitted and served at the same
+        # pressure reading
+        out = router.predict(data=np.zeros(IN_DIM, np.float32))
+        assert out[0].shape == (HID,)
+        snap = router.metrics.snapshot()
+        assert snap["shed"] == {"batch": 1}
+        assert snap["completed"] == {"interactive": 1}
+    finally:
+        router.close(stop_backends=True)
+
+
+def test_pressure_reflects_real_backlog():
+    net, params, srvs = _servers(1, max_wait_us=200000, max_queue=4)
+    router = serving.Router(srvs)
+    try:
+        assert router.pressure() == 0.0
+        futs = [srvs[0].submit(data=np.zeros(IN_DIM, np.float32))
+                for _ in range(4)]
+        assert router.pressure() == 1.0
+        for f in futs:  # flush deadline fires, queue drains
+            f.result(timeout=30)
+        assert router.pressure() == 0.0
+    finally:
+        router.close(stop_backends=True)
+
+
+def test_slo_class_deadline_budget():
+    """A class-level deadline budget applies when the request carries
+    none: queued past it, the future fails DeadlineExceededError and the
+    expiry is accounted per class."""
+    net, params, srvs = _servers(1, max_wait_us=300000)
+    classes = serving.router.default_slo_classes()
+    classes["interactive"] = serving.SLOClass("interactive", deadline_ms=20)
+    router = serving.Router(srvs, slo_classes=classes)
+    try:
+        fut = router.submit(data=np.zeros(IN_DIM, np.float32))
+        with pytest.raises(serving.DeadlineExceededError):
+            fut.result(timeout=30)
+        assert router.metrics.snapshot()["expired"] == {"interactive": 1}
+    finally:
+        router.close(stop_backends=True)
+
+
+@pytest.mark.chaos
+def test_hot_swap_under_load_zero_downtime(tmp_path):
+    """swap() rolls a new checkpoint through the fleet under sustained
+    load: no request fails, every answer matches the old or the new
+    params, post-swap traffic serves the new ones, and the warm-then-flip
+    keeps the recompile counter at zero (steady state never recompiles)."""
+    net, params1 = _tiny_model(seed=10)
+    _, params2 = _tiny_model(seed=11)
+    prefix = str(tmp_path / "swapm")
+    mx.model.save_checkpoint(prefix, 1, net,
+                             {k: v for k, v in params1.items()}, {})
+    mx.model.save_checkpoint(prefix, 2, net,
+                             {k: v for k, v in params2.items()}, {})
+    X = np.random.RandomState(6).randn(8, IN_DIM).astype(np.float32)
+    ref1 = _reference_outputs(net, params1, X)
+    ref2 = _reference_outputs(net, params2, X)
+
+    srvs = [serving.InferenceServer.from_checkpoint(
+        prefix, 1, {"data": (4, IN_DIM)}, max_wait_us=1000)
+        for _ in range(2)]
+    router = serving.Router(srvs, seed=7)
+    try:
+        stop_evt = threading.Event()
+        failures = []
+        outputs = []
+
+        def load():
+            i = 0
+            while not stop_evt.is_set():
+                try:
+                    out = router.predict(data=X[i % len(X)])
+                    outputs.append((i % len(X), out[0]))
+                except Exception as exc:  # any failure fails the test
+                    failures.append(exc)
+                i += 1
+
+        threads = [threading.Thread(target=load, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        swapped = router.swap(prefix, 2)
+        time.sleep(0.2)
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        assert swapped == 2
+        assert not failures, failures[:3]
+        assert len(outputs) > 0
+        for idx, out in outputs:  # old or new params, never garbage
+            assert (np.allclose(out, ref1[idx], rtol=1e-5, atol=1e-6)
+                    or np.allclose(out, ref2[idx], rtol=1e-5, atol=1e-6))
+        # steady state never recompiled: the shadows were warmed on every
+        # bucket before their atomic flip into rotation
+        assert router.cold_bucket_runs() == 0
+        snap = router.metrics.snapshot()
+        assert snap["failed"] == {}
+        assert snap["swaps"] == 2
+        # post-swap traffic runs the new params
+        out = router.predict(data=X[0])
+        np.testing.assert_allclose(out[0], ref2[0], rtol=1e-5, atol=1e-6)
+    finally:
+        stop_evt.set()
+        router.close(stop_backends=True)
+
+
+def test_server_inplace_swap(tmp_path):
+    """InferenceServer.swap flips the batcher onto warmed shadow
+    predictors without restarting: readiness never drops, and requests
+    after the flip serve the new params."""
+    net, params1 = _tiny_model(seed=12)
+    _, params2 = _tiny_model(seed=13)
+    prefix = str(tmp_path / "inplace")
+    mx.model.save_checkpoint(prefix, 1, net, dict(params1), {})
+    mx.model.save_checkpoint(prefix, 2, net, dict(params2), {})
+    X = np.random.RandomState(8).randn(4, IN_DIM).astype(np.float32)
+    srv = serving.InferenceServer.from_checkpoint(
+        prefix, 1, {"data": (4, IN_DIM)}, max_wait_us=1000)
+    try:
+        ref1 = _reference_outputs(net, params1, X)
+        ref2 = _reference_outputs(net, params2, X)
+        np.testing.assert_allclose(srv.predict(data=X[0])[0], ref1[0],
+                                   rtol=1e-5, atol=1e-6)
+        srv.swap(prefix, 2)
+        assert srv.ready()  # the swap never took the server out of rotation
+        np.testing.assert_allclose(srv.predict(data=X[0])[0], ref2[0],
+                                   rtol=1e-5, atol=1e-6)
+        assert srv.cold_bucket_runs() == 0
+    finally:
+        srv.stop()
+
+
+def test_router_http_front_door():
+    net, params, srvs = _servers(2)
+    X = np.random.RandomState(9).randn(2, IN_DIM).astype(np.float32)
+    ref = _reference_outputs(net, params, X)
+    router = serving.Router(srvs, seed=2)
+    try:
+        host, port = router.serve_http()
+        base = "http://%s:%d" % (host, port)
+        body = json.dumps({"inputs": {"data": X[0].tolist()}}).encode()
+        resp = urllib.request.urlopen(urllib.request.Request(
+            base + "/predict", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-SLO-Class": "interactive",
+                     "X-Request-Id": "req-http-1"}), timeout=30)
+        out = json.loads(resp.read())["outputs"]
+        np.testing.assert_allclose(np.asarray(out[0]), ref[0], rtol=1e-5,
+                                   atol=1e-6)
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as m:
+            text = m.read().decode()
+        assert "mxtpu_router_requests_total" in text
+        assert "mxtpu_router_latency_ms" in text
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as h:
+            assert h.read() == b"ok"
+        with urllib.request.urlopen(base + "/readyz", timeout=10) as r:
+            assert r.read() == b"ready"
+        with urllib.request.urlopen(base + "/replicas", timeout=10) as r:
+            reps = json.loads(r.read())
+        assert {d["name"] for d in reps} == {"r0", "r1"}
+        assert all(d["state"] == "closed" and d["ready"] for d in reps)
+        # a shed class surfaces as 429 + Retry-After, not a generic error
+        router.pressure = lambda: 1.0
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/predict", data=body,
+                headers={"Content-Type": "application/json",
+                         "X-SLO-Class": "batch"}), timeout=10)
+            raise AssertionError("expected HTTP 429")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 429
+            assert float(exc.headers["Retry-After"]) > 0
+            exc.close()
+    finally:
+        router.close(stop_backends=True)
+
+
+def test_remote_replica_backend():
+    """A Router can front an InferenceServer it only knows as host:port —
+    probes and calls go over HTTP, answers match the reference."""
+    net, params, srvs = _servers(1)
+    srv = srvs[0]
+    X = np.random.RandomState(10).randn(3, IN_DIM).astype(np.float32)
+    ref = _reference_outputs(net, params, X)
+    host, port = srv.serve_http()
+    router = serving.Router(["%s:%d" % (host, port)], seed=4)
+    try:
+        for i in range(3):
+            out = router.predict(data=X[i])
+            np.testing.assert_allclose(out[0], ref[i], rtol=1e-5, atol=1e-6)
+        d = router.describe()[0]
+        assert d["kind"] == "remote" and d["ready"]
+        assert router.metrics.snapshot()["completed"] == {"interactive": 3}
+    finally:
+        router.close()
+        srv.stop()
+
+
+def test_router_dispatch_emits_profiler_frames(tmp_path):
+    net, params, srvs = _servers(1)
+    trace = str(tmp_path / "router_trace.json")
+    router = serving.Router(srvs)
+    try:
+        mx.profiler.profiler_set_config(mode="all", filename=trace)
+        mx.profiler.profiler_set_state("run")
+        router.predict(data=np.zeros(IN_DIM, np.float32))
+        mx.profiler.profiler_set_state("stop")
+        mx.profiler.dump_profile()
+    finally:
+        router.close(stop_backends=True)
+    with open(trace) as f:
+        events = json.load(f)["traceEvents"]
+    names = {e["name"] for e in events}
+    assert any(n.startswith("router/dispatch") for n in names)
+    assert any(n.startswith("router/call") for n in names)

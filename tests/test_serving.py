@@ -313,6 +313,95 @@ def test_from_checkpoint(tmp_path):
         srv.stop()
 
 
+def test_ready_lifecycle_and_readyz_endpoint():
+    """Readiness (may I take traffic?) is distinct from liveness (am I
+    alive?): /readyz must say 503 while starting, warming, or stopped,
+    with the why-not in the body, while /healthz keeps its dead-worker
+    semantics untouched."""
+    import urllib.error
+
+    net, params = _tiny_model()
+    srv = serving.InferenceServer(net, dict(params), {"data": (4, IN_DIM)},
+                                  warmup=False, start=False)
+    try:
+        assert not srv.ready()
+        assert srv.ready_state() == "starting"
+        srv.start()
+        assert srv.ready()
+        assert srv.ready_state() == "ready"
+
+        host, port = srv.serve_http()
+        base = "http://%s:%d" % (host, port)
+        with urllib.request.urlopen(base + "/readyz", timeout=10) as r:
+            assert r.read() == b"ready"
+        # re-enter the warming window: /readyz flips to 503 "warming"
+        # while /healthz stays 200 — the router drains traffic off a
+        # warming replica without the orchestrator killing it
+        srv._warmed = False
+        assert srv.ready_state() == "warming"
+        try:
+            urllib.request.urlopen(base + "/readyz", timeout=10)
+            raise AssertionError("expected HTTP 503")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 503
+            assert json.loads(exc.read())["status"] == "warming"
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as h:
+            assert h.read() == b"ok"
+        srv.warmup()
+        assert srv.ready()
+        assert srv.cold_bucket_runs() == 0
+    finally:
+        srv.stop()
+    assert not srv.ready()
+    assert srv.ready_state() == "stopped"
+
+
+def test_stop_is_idempotent():
+    """A second stop() (any drain value) is a no-op: it must not re-fail
+    futures, re-join workers, or raise — and submit() after stop raises
+    the typed ServerClosedError immediately instead of queueing into the
+    dead batcher."""
+    net, params = _tiny_model()
+    srv = serving.InferenceServer(net, dict(params), {"data": (8, IN_DIM)},
+                                  max_wait_us=500000, warmup=False)
+    X = np.random.RandomState(6).randn(3, IN_DIM).astype(np.float32)
+    futs = [srv.submit(data=X[i]) for i in range(3)]
+    srv.stop(drain=True)
+    results = [f.result(timeout=1) for f in futs]
+    assert len(results) == 3
+    srv.stop(drain=False)  # no-op: the drained results stay results
+    srv.stop()
+    assert all(f.exception() is None for f in futs)
+    with pytest.raises(serving.ServerClosedError):
+        srv.submit(data=X[0])
+
+
+def test_http_deadline_header():
+    """X-Deadline-Ms on /predict must reach submit(deadline_ms=...): a
+    request that can't make its deadline dies as a 504, not as unbounded
+    queueing."""
+    import urllib.error
+
+    net, params = _tiny_model()
+    srv = serving.InferenceServer(net, dict(params), {"data": (8, IN_DIM)},
+                                  max_wait_us=200000, warmup=False)
+    try:
+        host, port = srv.serve_http()
+        body = json.dumps(
+            {"inputs": {"data": list(range(IN_DIM))}}).encode()
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                "http://%s:%d/predict" % (host, port), data=body,
+                headers={"Content-Type": "application/json",
+                         "X-Deadline-Ms": "10"}), timeout=30)
+            raise AssertionError("expected HTTP 504")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 504
+        assert srv.metrics.snapshot()["requests_expired"] == 1
+    finally:
+        srv.stop()
+
+
 def test_healthz_degraded_when_worker_thread_dies():
     """A dead replica worker must flip /healthz to 503 degraded (with the
     dead thread named) and bump the worker_crashes counter — a server

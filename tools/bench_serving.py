@@ -6,11 +6,20 @@ line per load point reports achieved QPS, latency quantiles, mean batch
 occupancy, and the reject/expire rates — the capacity-planning companion
 to tools/perf_probe.py (same style: stdlib-only CLI, JSON out).
 
+With ``--router N`` the sweep instead drives a resilient Router front
+door over N single-replica InferenceServers with a mixed SLO workload
+(interactive + sheddable batch) and hard-kills one backend halfway
+through each load point — the row then reports per-SLO-class p50/p99,
+achieved throughput, and the failover/shed accounting, so the record
+doubles as a "replica death costs latency, not errors" regression check.
+
 Usage:
   python tools/bench_serving.py [--load 50,200,800] [--duration 3]
                                 [--max-batch 32] [--max-wait-us 2000]
                                 [--hidden 256] [--in-dim 512]
-                                [--replicas 1] [--out bench_serving.jsonl]
+                                [--replicas 1] [--router 0]
+                                [--batch-frac 0.2]
+                                [--out bench_serving.jsonl]
 """
 import argparse
 import json
@@ -103,6 +112,83 @@ def run_load_point(srv, offered_qps, duration, in_dim, n_threads=8):
     }
 
 
+def build_router_fleet(cli):
+    import mxnet_tpu as mx
+
+    n = cli.router
+    srvs = [build_server(cli) for _ in range(n)]
+    return srvs, mx.serving.Router(srvs, seed=0)
+
+
+def run_router_point(router, victim, offered_qps, duration, in_dim,
+                     batch_frac, n_threads=8):
+    """One open-loop load point through the Router with a mixed SLO
+    workload; the victim backend is hard-killed (no drain) halfway
+    through, so the row captures failover behaviour, not steady state."""
+    import numpy as np
+    from mxnet_tpu import serving
+
+    x = np.zeros(in_dim, np.float32)
+    stop_at = time.monotonic() + duration
+    counts = {"submitted": 0, "shed": 0, "failed": 0, "expired": 0}
+    lock = threading.Lock()
+    futures = []
+    per_thread_qps = offered_qps / n_threads
+
+    def submitter(seed):
+        rng = random.Random(seed)
+        while time.monotonic() < stop_at:
+            time.sleep(rng.expovariate(per_thread_qps))
+            slo = "batch" if rng.random() < batch_frac else "interactive"
+            try:
+                fut = router.submit(slo=slo, data=x)
+                with lock:
+                    counts["submitted"] += 1
+                    futures.append(fut)
+            except serving.RouterOverloadError:
+                with lock:
+                    counts["shed"] += 1
+
+    killer = threading.Timer(duration / 2,
+                             lambda: victim.stop(drain=False))
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=submitter, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    killer.start()
+    for t in threads:
+        t.join()
+    killer.join()
+    for fut in futures:
+        try:
+            fut.result(timeout=60)
+        except serving.DeadlineExceededError:
+            counts["expired"] += 1
+        except Exception:
+            counts["failed"] += 1
+    elapsed = time.monotonic() - t0
+    snap = router.metrics.snapshot()
+    row = {
+        "mode": "router",
+        "offered_qps": offered_qps,
+        "achieved_qps": counts["submitted"] / elapsed,
+        "submitted": counts["submitted"],
+        "shed": counts["shed"],
+        "failed": counts["failed"],
+        "expired": counts["expired"],
+        "retries": snap["retries"],
+        "hedges": snap["hedges"],
+        "breaker_transitions": snap["breaker_transitions"],
+    }
+    for slo in ("interactive", "batch"):
+        for q, key in ((.50, "p50"), (.99, "p99")):
+            v = router.metrics.latency_quantile(q, slo)
+            if v is not None:
+                row["latency_ms_%s_%s" % (key, slo)] = v
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--load", default="50,200,800",
@@ -114,6 +200,12 @@ def main():
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--in-dim", type=int, default=512)
     ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--router", type=int, default=0, metavar="N",
+                    help="route through a Router over N backend servers, "
+                         "killing one mid-run (0 = plain server sweep)")
+    ap.add_argument("--batch-frac", type=float, default=0.2,
+                    help="fraction of router traffic in the sheddable "
+                         "'batch' SLO class")
     ap.add_argument("--out", default=None,
                     help="also append JSON lines to this file")
     cli = ap.parse_args()
@@ -121,12 +213,21 @@ def main():
     loads = [float(s) for s in cli.load.split(",") if s]
     sink = open(cli.out, "a") if cli.out else None
     for qps in loads:
-        # fresh server per point so histograms/latency don't bleed across
-        srv = build_server(cli)
-        try:
-            row = run_load_point(srv, qps, cli.duration, cli.in_dim)
-        finally:
-            srv.stop()
+        # fresh server/fleet per point so histograms don't bleed across
+        if cli.router:
+            srvs, router = build_router_fleet(cli)
+            try:
+                row = run_router_point(router, srvs[-1], qps, cli.duration,
+                                       cli.in_dim, cli.batch_frac)
+            finally:
+                router.close(stop_backends=True)
+            row["router_replicas"] = cli.router
+        else:
+            srv = build_server(cli)
+            try:
+                row = run_load_point(srv, qps, cli.duration, cli.in_dim)
+            finally:
+                srv.stop()
         row["max_batch"] = cli.max_batch
         row["max_wait_us"] = cli.max_wait_us
         row["replicas"] = cli.replicas

@@ -25,6 +25,16 @@ properties end to end:
     # elastic membership churn: kill -> evict -> respawn-join
     python tools/chaos_run.py --scenario membership-churn --seeds 0:5
 
+    # serving front door: replica failure + breaker recovery + hot-swap
+    python tools/chaos_run.py --scenario serving-failover --seeds 0:5
+
+``serving-failover`` drives a Router over N in-process InferenceServer
+replicas under sustained load while a seeded FaultPlan hard-fails one
+replica (the seed picks the victim), then lets it recover, then rolls a
+checkpoint hot-swap through the fleet — asserting zero failed client
+requests, breaker open -> half-open -> closed, and zero post-warmup
+recompiles.
+
 ``membership-churn`` runs N elastic workers against a sync-mode server
 with eviction enabled, hard-kills one mid-run under a seeded FaultPlan
 (the seed picks both the victim rank and the kill step), waits for the
@@ -214,7 +224,135 @@ def run_membership_churn(seed, timeout=120.0, workers=3, steps=10,
     return ok
 
 
-_SCENARIOS = {"membership-churn": run_membership_churn}
+def run_serving_failover(seed, timeout=120.0, replicas=3, load_threads=4):
+    """Serving front-door probe, in-process: a Router over ``replicas``
+    warmed InferenceServer replicas takes sustained load while a seeded
+    FaultPlan hard-fails every call to one victim replica (the seed picks
+    the victim), then the fault clears, then a checkpoint hot-swap rolls
+    through the fleet — all under load.  Passes when zero client requests
+    failed end to end, the victim's breaker opened and re-closed after
+    recovery, the swap served the new params, and the warm-then-flip kept
+    the recompile counter at zero."""
+    import tempfile
+    import threading
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    in_dim, hid = 6, 3
+    rng = np.random.RandomState(seed)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=hid,
+                                name="fc")
+
+    def ckpt_params(s):
+        r = np.random.RandomState(s)
+        return {"fc_weight": mx.nd.array(
+                    r.randn(hid, in_dim).astype(np.float32)),
+                "fc_bias": mx.nd.array(r.randn(hid).astype(np.float32))}
+
+    victim = "r%d" % (seed % replicas)
+    spec = "serving.replica.%s.call:ioerr=1" % victim
+    print("chaos_run: serving-failover seed %d: victim %s (spec %r), "
+          "%d replicas" % (seed, victim, spec, replicas),
+          file=sys.stderr, flush=True)
+
+    tmp = tempfile.mkdtemp(prefix="chaos-serving-")
+    prefix = os.path.join(tmp, "m")
+    mx.model.save_checkpoint(prefix, 1, net, ckpt_params(seed + 1), {})
+    mx.model.save_checkpoint(prefix, 2, net, ckpt_params(seed + 2), {})
+    srvs = [serving.InferenceServer.from_checkpoint(
+        prefix, 1, {"data": (4, in_dim)}, max_wait_us=1000)
+        for _ in range(replicas)]
+    router = serving.Router(srvs, seed=seed, retries=2,
+                            breaker_threshold=3, breaker_cooldown_ms=100)
+    X = rng.randn(8, in_dim).astype(np.float32)
+    stop_evt = threading.Event()
+    failures = []
+    served = [0]
+
+    def load():
+        i = 0
+        while not stop_evt.is_set():
+            try:
+                router.predict(data=X[i % len(X)])
+                served[0] += 1
+            except Exception as exc:
+                failures.append(repr(exc))
+            i += 1
+
+    deadline = time.monotonic() + timeout
+    ok = True
+    threads = [threading.Thread(target=load, daemon=True)
+               for _ in range(load_threads)]
+    try:
+        for t in threads:
+            t.start()
+        # phase 1: hard-fail the victim mid-load until its breaker opens
+        mx.faults.install(mx.faults.FaultPlan(spec, seed))
+        try:
+            while time.monotonic() < deadline:
+                snap = router.metrics.snapshot()
+                if snap["breaker_transitions"].get("open"):
+                    break
+                time.sleep(0.05)
+            else:
+                print("chaos_run: breaker never opened", file=sys.stderr)
+                ok = False
+        finally:
+            mx.faults.uninstall()
+        # phase 2: fault cleared — the breaker must walk half-open ->
+        # closed on a probe request while the load keeps flowing
+        while time.monotonic() < deadline:
+            states = {d["name"]: d["state"] for d in router.describe()}
+            if states.get(victim) == serving.router.BREAKER_CLOSED:
+                break
+            time.sleep(0.05)
+        else:
+            print("chaos_run: breaker never re-closed", file=sys.stderr)
+            ok = False
+        # phase 3: zero-downtime hot-swap under the same load
+        swapped = router.swap(prefix, 2)
+        time.sleep(0.2)
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=max(1.0, deadline - time.monotonic()))
+    finally:
+        stop_evt.set()
+        router.close(stop_backends=True)
+
+    snap = router.metrics.snapshot()
+    if failures or snap["failed"]:
+        print("chaos_run: %d client requests failed (first: %s)"
+              % (len(failures), failures[:3]), file=sys.stderr, flush=True)
+        ok = False
+    if swapped != replicas:
+        print("chaos_run: swap covered %d/%d replicas" % (swapped, replicas),
+              file=sys.stderr, flush=True)
+        ok = False
+    cold = router.cold_bucket_runs()
+    if cold:
+        print("chaos_run: %d post-warmup recompiles — the swap shadows "
+              "were not fully warmed" % cold, file=sys.stderr, flush=True)
+        ok = False
+    if ok:
+        print("chaos_run: served %d requests, 0 failed; breaker %s; "
+              "swap ok (0 recompiles)"
+              % (served[0], dict(snap["breaker_transitions"])),
+              file=sys.stderr, flush=True)
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    return ok
+
+
+_SCENARIOS = {"membership-churn": run_membership_churn,
+              "serving-failover": run_serving_failover}
 
 
 def main():
