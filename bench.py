@@ -90,6 +90,11 @@ def _arg_parser():
     ap.add_argument("--shard-probe-timeout", type=int, default=600,
                     help="seconds before the shard-probe subprocess is "
                          "killed")
+    ap.add_argument("--skip-coldstart", action="store_true",
+                    help="omit the CPU-only serving cold-start phase")
+    ap.add_argument("--coldstart-timeout", type=int, default=300,
+                    help="seconds before each cold-start subprocess is "
+                         "killed")
     return ap
 
 
@@ -410,6 +415,54 @@ def _shard_probe_fields(timeout=600):
                                                "; ".join(tail[-2:])[:300])}
 
 
+def _coldstart_fields(timeout=300):
+    """CPU-only serving cold-start phase (tools/bench_coldstart.py):
+    time-to-first-prediction for a fresh replica, measured cold (empty
+    compile cache: every bucket compiles) and again warm (same cache
+    dir: every bucket deserializes).  The warm run must report cache
+    hits with zero compiles and a bit-identical first prediction — the
+    PR-10 compile-once acceptance measurement, runnable with no
+    accelerator."""
+    import tempfile
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_coldstart.py")
+
+    def run_once(cache_dir):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   MXNET_COMPILE_CACHE_DIR=cache_dir)
+        proc = subprocess.run([sys.executable, script],
+                              capture_output=True, text=True,
+                              timeout=timeout, env=env)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        raise RuntimeError("rc=%d %s" % (proc.returncode,
+                                         "; ".join(tail[-2:])[:300]))
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="mxtpu-cc-bench-") as d:
+            cold = run_once(d)
+            warm = run_once(d)
+    except (subprocess.TimeoutExpired, RuntimeError, OSError) as e:
+        return {"coldstart_error": str(e)[:300]}
+    fields = {
+        "coldstart_cold_ttfp_ms": cold.get("ttfp_ms"),
+        "coldstart_warm_ttfp_ms": warm.get("ttfp_ms"),
+        "coldstart_warm_hits": warm.get("cache", {}).get("hits"),
+        "coldstart_warm_compiles": warm.get("cache", {}).get("misses"),
+        "coldstart_outputs_identical":
+            cold.get("out_digest") == warm.get("out_digest"),
+    }
+    if cold.get("ttfp_ms") and warm.get("ttfp_ms"):
+        fields["coldstart_speedup"] = round(
+            cold["ttfp_ms"] / warm["ttfp_ms"], 2)
+    return fields
+
+
 def _probe_backend(timeout=300):
     """Claim and release the backend in a subprocess. Returns None when
     healthy, else a short error string."""
@@ -452,10 +505,13 @@ def orchestrate(argv=None):
         _kvstore_fields(cli.kvstore_timeout)
     shard_fields = {} if cli.skip_shard_probe else \
         _shard_probe_fields(cli.shard_probe_timeout)
+    coldstart_fields = {} if cli.skip_coldstart else \
+        _coldstart_fields(cli.coldstart_timeout)
 
     def finish(rec):
         rec.update(kv_fields)
         rec.update(shard_fields)
+        rec.update(coldstart_fields)
         print(json.dumps(rec))
         return rec
 

@@ -23,7 +23,8 @@ from typing import Dict, Optional, Tuple
 from .base import MXNetError
 
 __all__ = ["save_sharded_checkpoint", "load_sharded_checkpoint",
-           "load_partition_specs"]
+           "load_partition_specs", "aot_bundle_path", "save_aot_bundle",
+           "attach_aot_bundle"]
 
 # written (by process 0) only after every process's shards have landed; a
 # directory without it is a crash-torn save.  Orbax's own commit marker
@@ -208,3 +209,44 @@ def _as_jax(v):
     import jax.numpy as jnp
 
     return v if hasattr(v, "devices") else jnp.asarray(v)
+
+
+# ---------------------------------------------------------------------------
+# AOT executable bundles — the compiled half of a checkpoint.  Params say
+# WHAT the model computes; the bundle carries the compiled HOW (serialized
+# XLA executables + a warmup manifest), so a fresh replica restored from
+# this prefix is serving in seconds instead of sitting in the compiler.
+# ---------------------------------------------------------------------------
+
+def aot_bundle_path(prefix, epoch):
+    """``prefix-NNNN.aot/`` next to the params — same naming family as
+    ``prefix-NNNN.params`` / ``prefix-NNNN.orbax``."""
+    return os.path.abspath("%s-%04d.aot" % (prefix, epoch))
+
+
+def save_aot_bundle(prefix, epoch, entries, warmup=None):
+    """Write the AOT executable bundle beside a checkpoint.
+
+    ``entries``: primed ``compile_cache.CachedFunction`` wrappers —
+    typically ``BucketedPredictor.compiled_entries()`` over every serving
+    replica, so the bundle holds one executable per warmed bucket.
+    ``warmup``: a manifest dict (input shapes, buckets, dtype) recording
+    how to re-drive the same warmup.  Returns the bundle path."""
+    from . import compile_cache
+
+    return compile_cache.save_bundle(aot_bundle_path(prefix, epoch),
+                                     entries, warmup=warmup)
+
+
+def attach_aot_bundle(prefix, epoch, mesh=None):
+    """Attach ``prefix-NNNN.aot/`` as a read-only compile-cache overlay;
+    returns the manifest (or None when no bundle exists).  Raises
+    :class:`MXNetError` when the bundle was built for a different device
+    topology or mesh — a mismatched executable restore must fail loudly,
+    not serve a wrong layout."""
+    from . import compile_cache
+
+    path = aot_bundle_path(prefix, epoch)
+    if not os.path.exists(os.path.join(path, compile_cache.MANIFEST_NAME)):
+        return None
+    return compile_cache.attach_bundle(path, mesh=mesh)

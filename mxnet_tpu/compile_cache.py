@@ -1,0 +1,728 @@
+"""Persistent XLA compilation cache + AOT executable bundles.
+
+ROADMAP item 5: every process used to pay full XLA compilation on
+startup — a fresh serving replica warmed every bucket through the
+compiler, an elastic fresh-rank joiner recompiled the fused step its
+peers were already running, and a hot-swap shadow replica recompiled
+before it could flip.  This module makes the compiled executable itself
+a durable, content-addressed artifact (the TVM compile-artifact-reuse
+idea, arXiv:1802.04799, applied at the XLA executable layer):
+
+* every lowered program the executor stack builds (fused train step,
+  forward, forward+backward — and therefore every serving bucket) is
+  keyed by a **content fingerprint**: the batch signature of its
+  arguments (the StepMonitor recompile detector's machinery), a hash of
+  the symbol graph, the static trace knobs (mixed-precision dtype,
+  remat, ctx-group placement, grad_req partition, optimizer family and
+  hypers), and the stable sharding fingerprint (mesh axes/devices +
+  PartitionSpecs);
+* on miss the program is lowered and compiled exactly as before, then
+  the executable is serialized (``jax.experimental.serialize_executable``)
+  into an atomic, CRC-checked cache entry (same tmp+fsync+rename
+  discipline as checkpoints);
+* on hit the executable deserializes in milliseconds and **no XLA
+  compilation happens at all**.
+
+Environment compatibility (jax/jaxlib version, backend, device
+kind/count, process count) is recorded in every entry and checked at
+load: a mismatched entry is a miss (invalidation), never a crash.  Cache
+I/O is a ``faults`` dotted op (``compile_cache.load`` /
+``compile_cache.store``) so chaos tests can prove a corrupt or torn
+entry degrades to a plain recompile.  Telemetry:
+``mxtpu_compile_cache_hits_total`` / ``_misses_total`` /
+``_stores_total`` / ``_errors_total`` plus compile-ms vs deserialize-ms
+histograms.
+
+AOT bundles (``checkpoint.save_aot_bundle``) re-pack the live entries a
+serving process is running into a directory next to the params, with a
+warmup manifest — a new replica attaches the bundle as a read-only
+cache overlay and its whole warmup is deserialize-only.
+
+Enable with ``MXNET_COMPILE_CACHE_DIR=/path`` (empty default = off: the
+executor stack behaves exactly as before).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .base import MXNetError, env, register_env
+
+__all__ = [
+    "enabled", "cache_dir", "env_fingerprint", "stats", "reset_stats",
+    "maybe_cached", "CachedFunction", "attach_bundle", "detach_bundles",
+    "save_bundle", "read_manifest", "ls_entries", "verify_entry", "prune",
+    "entry_meta", "MANIFEST_NAME", "ENTRY_SUFFIX",
+]
+
+register_env("MXNET_COMPILE_CACHE_DIR", "", str,
+             "Directory for the persistent framework-level compilation "
+             "cache (serialized XLA executables, content-fingerprint "
+             "keyed). Empty disables the cache entirely.")
+register_env("MXNET_COMPILE_CACHE_MAX_MB", 2048, int,
+             "Size budget for the compile-cache directory; after a store "
+             "the oldest entries (by mtime) are pruned until under "
+             "budget. <= 0 disables pruning.")
+register_env("MXNET_COMPILE_CACHE_STRICT", 0, int,
+             "1 makes cache load/store failures raise instead of "
+             "degrading to a plain recompile (debugging aid; production "
+             "keeps 0: a broken cache must never break the job).")
+register_env("MXNET_COMPILE_CACHE_MIN_MS", 0.0, float,
+             "Only compilations that took at least this many ms are "
+             "stored (0 stores everything). Skips serializing trivial "
+             "programs whose recompile is cheaper than the disk entry.")
+
+_MAGIC = b"MXTPUCC1"
+_SCHEMA = 1
+ENTRY_SUFFIX = ".mxc"
+MANIFEST_NAME = "manifest.json"
+
+_lock = threading.Lock()
+# process-wide loaded-executable cache: a hot-swap shadow replica in the
+# same process inherits the outgoing replica's executables without even
+# touching the disk.  key digest -> (callable, meta)
+_mem: Dict[str, Tuple[Any, dict]] = {}
+# read-only overlay directories (attached AOT bundles), searched after
+# the primary cache dir
+_bundles: List[str] = []
+_env_fp_cache: Optional[dict] = None
+
+
+def enabled() -> bool:
+    return bool(env("MXNET_COMPILE_CACHE_DIR", "", str))
+
+
+def active() -> bool:
+    """True when executables may come out of (or go into) the cache:
+    the on-disk cache is enabled or an AOT bundle overlay is attached.
+    The executor stack uses this to build cache-eligible programs
+    without buffer donation — XLA's executable deserializer has been
+    observed to mis-bind donated (input-output aliased) arguments that
+    share a shape, so persisted executables must not rely on it."""
+    return enabled() or bool(_bundles)
+
+
+def cache_dir() -> str:
+    return env("MXNET_COMPILE_CACHE_DIR", "", str)
+
+
+def _strict() -> bool:
+    return bool(env("MXNET_COMPILE_CACHE_STRICT", 0, int))
+
+
+# ---------------------------------------------------------------------------
+# telemetry instruments (global registry; cheap even with telemetry off —
+# these fire once per executable build, never per step)
+# ---------------------------------------------------------------------------
+
+_instruments = None
+
+
+def _metrics():
+    global _instruments
+    if _instruments is None:
+        from . import telemetry as tm
+
+        reg = tm.registry()
+        _instruments = {
+            "hits": reg.counter(
+                "mxtpu_compile_cache_hits_total",
+                "Executable builds satisfied by deserializing a cache "
+                "entry (no XLA compilation)."),
+            "misses": reg.counter(
+                "mxtpu_compile_cache_misses_total",
+                "Executable builds that had to run the XLA compiler."),
+            "stores": reg.counter(
+                "mxtpu_compile_cache_stores_total",
+                "Cache entries written."),
+            "errors": reg.counter(
+                "mxtpu_compile_cache_errors_total",
+                "Cache load/store failures degraded to a recompile "
+                "(corrupt entry, torn write, injected fault)."),
+            "compile_ms": reg.histogram(
+                "mxtpu_compile_ms",
+                "XLA compile time per cache-miss executable build (ms).",
+                start=1.0, factor=4.0, count=12),
+            "deserialize_ms": reg.histogram(
+                "mxtpu_compile_cache_deserialize_ms",
+                "Executable deserialize time per cache hit (ms).",
+                start=0.25, factor=4.0, count=12),
+        }
+    return _instruments
+
+
+def _log_event(kind, **fields):
+    try:
+        from . import telemetry as tm
+
+        tm.log_event(kind, **fields)
+    except Exception:
+        pass
+
+
+def stats() -> dict:
+    """Compact counters for BENCH / capture records."""
+    m = _metrics()
+    return {
+        "dir": cache_dir() or None,
+        "hits": m["hits"].value,
+        "misses": m["misses"].value,
+        "stores": m["stores"].value,
+        "errors": m["errors"].value,
+        "compile_ms": round(m["compile_ms"].sum, 1),
+        "deserialize_ms": round(m["deserialize_ms"].sum, 1),
+    }
+
+
+def reset_stats() -> None:
+    """Test hook: drop instrument handles (a telemetry registry reset
+    leaves stale handles otherwise) and the in-memory executable cache."""
+    global _instruments
+    with _lock:
+        _instruments = None
+        _mem.clear()
+        del _bundles[:]
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+def env_fingerprint() -> dict:
+    """The compatibility envelope an executable is only valid inside:
+    jax/jaxlib versions, backend platform, device kind and count, process
+    count.  Recorded in every entry and checked at load — any mismatch
+    invalidates (a miss, never a crash)."""
+    global _env_fp_cache
+    if _env_fp_cache is None:
+        import jax
+        import jaxlib
+
+        devs = jax.devices()
+        _env_fp_cache = {
+            "schema": _SCHEMA,
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "platform": jax.default_backend(),
+            "device_kind": devs[0].device_kind if devs else "none",
+            "device_count": len(devs),
+            "process_count": jax.process_count(),
+        }
+    return dict(_env_fp_cache)
+
+
+def _signature(args) -> dict:
+    """The batch-signature half of the key: (shape, dtype) per leaf plus
+    the pytree structure (which pins argument names and None slots)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    import numpy as np
+
+    sig = []
+    for leaf in leaves:
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        sig.append([list(shape), dtype])
+    return {"tree": str(treedef), "leaves": sig}
+
+
+def _digest(parts: dict) -> str:
+    blob = json.dumps(parts, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:32]
+
+
+# ---------------------------------------------------------------------------
+# entry file format:  MAGIC | u64 meta_len | meta json | pickle(payload)
+# with a CRC32 sidecar (filesystem.write_crc_sidecar) over the file
+# ---------------------------------------------------------------------------
+
+def _entry_path(d: str, digest: str) -> str:
+    return os.path.join(d, digest + ENTRY_SUFFIX)
+
+
+def entry_meta(path: str) -> dict:
+    """Parse just the json header of an entry (no unpickling)."""
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise MXNetError("%s is not a compile-cache entry" % path)
+        mlen = int.from_bytes(f.read(8), "little")
+        if mlen <= 0 or mlen > (1 << 24):
+            raise MXNetError("%s has an implausible meta header" % path)
+        return json.loads(f.read(mlen).decode())
+
+
+def _write_entry(d: str, digest: str, meta: dict, payload_bytes: bytes,
+                 op: str = "compile_cache.store") -> str:
+    from .filesystem import atomic_write
+
+    os.makedirs(d, exist_ok=True)
+    meta_blob = json.dumps(meta, sort_keys=True, default=str).encode()
+    path = _entry_path(d, digest)
+
+    def writer(f):
+        f.write(_MAGIC)
+        f.write(len(meta_blob).to_bytes(8, "little"))
+        f.write(meta_blob)
+        f.write(payload_bytes)
+
+    # atomic_write fires the fault layer under our dotted op and lands
+    # the CRC sidecar after the data — identical discipline to checkpoints
+    atomic_write(path, writer, checksum=True, op=op)
+    return path
+
+
+def _read_payload(path: str) -> Tuple[dict, bytes]:
+    with open(path, "rb") as f:
+        blob = f.read()
+    if blob[:len(_MAGIC)] != _MAGIC:
+        raise MXNetError("%s is not a compile-cache entry" % path)
+    off = len(_MAGIC)
+    mlen = int.from_bytes(blob[off:off + 8], "little")
+    off += 8
+    if mlen <= 0 or off + mlen > len(blob):
+        raise MXNetError("%s has a torn meta header" % path)
+    meta = json.loads(blob[off:off + mlen].decode())
+    return meta, blob[off + mlen:]
+
+
+def _env_compatible(meta: dict) -> bool:
+    return meta.get("env") == env_fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# load / store
+# ---------------------------------------------------------------------------
+
+def _read_dirs() -> List[str]:
+    d = cache_dir()
+    out = [d] if d else []
+    with _lock:
+        out.extend(_bundles)
+    return out
+
+
+def _load(digest: str):
+    """-> (callable, meta) or None.  Every failure mode — missing file,
+    CRC mismatch, torn header, unpicklable payload, injected fault —
+    degrades to None (a miss) with a structured telemetry event."""
+    with _lock:
+        hit = _mem.get(digest)
+    if hit is not None:
+        return hit
+    from . import faults
+    from .filesystem import verify_crc_sidecar
+
+    for d in _read_dirs():
+        path = _entry_path(d, digest)
+        if not os.path.exists(path):
+            continue
+        try:
+            faults.fire("compile_cache.load")
+            ok = verify_crc_sidecar(path)
+            if ok is False:
+                raise MXNetError("CRC mismatch")
+            meta, payload = _read_payload(path)
+            if not _env_compatible(meta):
+                _log_event("compile_cache_invalidate", path=path,
+                           entry_env=meta.get("env"),
+                           current_env=env_fingerprint())
+                continue  # stale-version entry: a miss, not an error
+            from jax.experimental import serialize_executable as se
+
+            t0 = time.perf_counter()
+            loaded = se.deserialize_and_load(*pickle.loads(payload))
+            ms = (time.perf_counter() - t0) * 1e3
+            _metrics()["deserialize_ms"].observe(ms)
+            with _lock:
+                _mem[digest] = (loaded, meta)
+            _log_event("compile_cache_hit", digest=digest, path=path,
+                       deserialize_ms=round(ms, 3))
+            return loaded, meta
+        except Exception as exc:
+            _metrics()["errors"].inc()
+            _log_event("compile_cache_corrupt", path=path,
+                       error=repr(exc)[:300])
+            if _strict():
+                raise
+            continue
+    return None
+
+
+def _store(digest: str, compiled, meta: dict, compile_ms: float) -> Optional[str]:
+    d = cache_dir()
+    if not d:
+        return None
+    min_ms = env("MXNET_COMPILE_CACHE_MIN_MS", 0.0, float)
+    if compile_ms < min_ms:
+        return None
+    try:
+        from jax.experimental import serialize_executable as se
+
+        payload = pickle.dumps(se.serialize(compiled))
+        path = _write_entry(d, digest, meta, payload)
+        _metrics()["stores"].inc()
+        _log_event("compile_cache_store", digest=digest, path=path,
+                   bytes=len(payload), compile_ms=round(compile_ms, 1))
+        budget = env("MXNET_COMPILE_CACHE_MAX_MB", 2048, int)
+        if budget > 0:
+            prune(d, budget)
+        return path
+    except Exception as exc:
+        _metrics()["errors"].inc()
+        _log_event("compile_cache_store_failed", digest=digest,
+                   error=repr(exc)[:300])
+        if _strict():
+            raise
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the executor-facing wrapper
+# ---------------------------------------------------------------------------
+
+class CachedFunction:
+    """Lazy cache-aware stand-in for a ``jax.jit`` callable.
+
+    The first call under each argument signature fingerprints the
+    concrete arguments, consults the cache (memory, then the cache dir,
+    then attached bundles), and either deserializes the executable
+    (hit: no XLA compilation) or AOT-compiles via ``lower().compile()``
+    and stores the result.  Subsequent calls with the same signature go
+    straight to the loaded executable; a NEW signature re-primes — the
+    same retrace-on-shape-change contract as plain ``jax.jit``.  Any
+    cache malfunction falls back to the wrapped jit callable, which
+    behaves exactly as if the cache never existed.
+    """
+
+    __slots__ = ("_fn", "_kind", "_static_key", "_executor", "_by_sig",
+                 "records", "digest", "meta", "cost_info", "cache_state")
+
+    def __init__(self, fn, kind: str, static_key, executor):
+        self._fn = fn
+        self._kind = kind
+        self._static_key = static_key
+        self._executor = executor
+        self._by_sig: Dict[Any, Any] = {}
+        # one record per primed signature (bundle export reads these):
+        # {"digest", "meta", "compiled" (live Compiled on miss else None)}
+        self.records: List[dict] = []
+        # most-recent prime, for the executor/introspection wiring
+        self.digest = None
+        self.meta = None
+        self.cost_info = None
+        self.cache_state = None  # "hit" | "miss" | "bypass"
+
+    # delegation keeps telemetry.lower_and_analyze / perf_probe working
+    # against the introspection hook unchanged
+    def lower(self, *args, **kwargs):
+        return self._fn.lower(*args, **kwargs)
+
+    @staticmethod
+    def _quick_sig(args):
+        """Hashable per-call signature — the dispatch key.  Cheap
+        (no hashing/serialization): treedef + leaf shapes/dtypes."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return (treedef, tuple(
+            (tuple(getattr(l, "shape", ())), str(getattr(l, "dtype", "")))
+            for l in leaves))
+
+    def __call__(self, *args):
+        fn = self._by_sig.get(self._quick_sig(args))
+        if fn is None:
+            fn = self._prime(args)
+        return fn(*args)
+
+    def _key_parts(self, args) -> dict:
+        ex = self._executor
+        plan = ex._plan
+        parts = {
+            "schema": _SCHEMA,
+            "kind": self._kind,
+            "static": repr(self._static_key),
+            "graph": plan.fingerprint(),
+            "compute_dtype": str(ex._compute_dtype),
+            "cast_exclude": sorted(ex._cast_exclude),
+            "remat": int(env("MXNET_BACKWARD_DO_MIRROR", 0, int) or 0),
+            "group2ctx": sorted(
+                (g, str(c)) for g, c in ex._group2ctx.items()),
+            "sig": _signature(args),
+        }
+        if ex._shard_mesh is not None:
+            from .sharding.mesh import mesh_fingerprint
+
+            parts["shard"] = {
+                "mesh": mesh_fingerprint(ex._shard_mesh),
+                "specs": sorted((k, str(v))
+                                for k, v in ex._shard_specs.items()),
+            }
+        return parts
+
+    def _register(self, sig, fn, state, digest=None, meta=None,
+                  compiled=None):
+        self._by_sig[sig] = fn
+        self.cache_state = state
+        self.digest = digest
+        self.meta = meta
+        self.cost_info = (meta or {}).get("cost") or None
+        if digest is not None:
+            self.records.append(
+                {"digest": digest, "meta": meta, "compiled": compiled})
+        return fn
+
+    def _prime(self, args):
+        sig = self._quick_sig(args)
+        digest = None
+        try:
+            parts = self._key_parts(args)
+            digest = _digest(parts)
+            hit = _load(digest)
+        except Exception as exc:
+            if _strict():
+                raise
+            _metrics()["errors"].inc()
+            _log_event("compile_cache_key_failed", kind=self._kind,
+                       error=repr(exc)[:300])
+            hit = None
+            if digest is None:
+                # can't even fingerprint: bypass the cache entirely
+                return self._register(sig, self._fn, "bypass")
+        if hit is not None:
+            loaded, meta = hit
+            _metrics()["hits"].inc()
+            return self._register(sig, loaded, "hit", digest, meta)
+        # miss: compile exactly as the plain jit path would, then store
+        _metrics()["misses"].inc()
+        try:
+            t0 = time.perf_counter()
+            compiled = self._fn.lower(*args).compile()
+            compile_ms = (time.perf_counter() - t0) * 1e3
+        except Exception:
+            # AOT lowering unsupported for this program: run the plain
+            # jit callable (compiles internally, uncached)
+            return self._register(sig, self._fn, "bypass")
+        _metrics()["compile_ms"].observe(compile_ms)
+        cost = _cost_of(compiled)
+        meta = self._build_meta(digest, compile_ms, cost)
+        with _lock:
+            _mem[digest] = (compiled, meta)
+        _store(digest, compiled, meta, compile_ms)
+        return self._register(sig, compiled, "miss", digest, meta, compiled)
+
+    def _build_meta(self, digest, compile_ms, cost) -> dict:
+        ex = self._executor
+        mesh_axes = None
+        if ex._shard_mesh is not None:
+            mesh = ex._shard_mesh
+            mesh_axes = {str(n): int(mesh.shape[n]) for n in mesh.axis_names}
+        return {
+            "digest": digest,
+            "kind": self._kind,
+            "env": env_fingerprint(),
+            "mesh_axes": mesh_axes,
+            "created": round(time.time(), 3),
+            "compile_ms": round(compile_ms, 1),
+            "cost": cost,
+        }
+
+
+def _cost_of(compiled) -> Optional[dict]:
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return {"flops": ca.get("flops"),
+                "bytes_accessed": ca.get("bytes accessed")}
+    except Exception:
+        return None
+
+
+def maybe_cached(fn, kind: str, static_key, executor):
+    """Executor hook: wrap a jit callable in a :class:`CachedFunction`
+    when the cache is enabled, else return it untouched (the default —
+    zero behavior change with no cache dir configured)."""
+    if not enabled() and not _bundles:
+        return fn
+    return CachedFunction(fn, kind, static_key, executor)
+
+
+# ---------------------------------------------------------------------------
+# AOT bundles — a read-only cache overlay saved beside a checkpoint
+# ---------------------------------------------------------------------------
+
+def save_bundle(path: str, entries, warmup: Optional[dict] = None) -> str:
+    """Write an AOT executable bundle: one cache entry per compiled
+    program in ``entries`` (:class:`CachedFunction` wrappers, typically
+    every bucket of a serving replica) plus ``manifest.json`` recording
+    the warmup recipe and the environment fingerprint.  Entries whose
+    executable came from the cache are copied from their source entry
+    file; fresh compiles are serialized directly."""
+    os.makedirs(path, exist_ok=True)
+    from .filesystem import atomic_write
+
+    manifest = {
+        "schema": _SCHEMA,
+        "env": env_fingerprint(),
+        "created": round(time.time(), 3),
+        "warmup": warmup or {},
+        "entries": [],
+    }
+    seen = set()
+    for wrapper in entries:
+        for rec in getattr(wrapper, "records", []) or []:
+            digest, meta = rec["digest"], rec["meta"] or {}
+            if digest in seen:
+                continue
+            if rec.get("compiled") is not None:
+                from jax.experimental import serialize_executable as se
+
+                payload = pickle.dumps(se.serialize(rec["compiled"]))
+            else:
+                # executable was itself deserialized: copy its source entry
+                src = None
+                for d in _read_dirs():
+                    p = _entry_path(d, digest)
+                    if os.path.exists(p):
+                        src = p
+                        break
+                if src is None:
+                    continue
+                _, payload = _read_payload(src)
+            _write_entry(path, digest, meta, payload)
+            seen.add(digest)
+            manifest["entries"].append({
+                "digest": digest,
+                "kind": meta.get("kind"),
+                "mesh_axes": meta.get("mesh_axes"),
+                "cost": meta.get("cost"),
+            })
+    atomic_write(os.path.join(path, MANIFEST_NAME),
+                 lambda f: f.write(json.dumps(manifest, indent=1,
+                                              default=str).encode()),
+                 checksum=True, op="compile_cache.store")
+    _log_event("compile_cache_bundle_saved", path=path,
+               entries=len(manifest["entries"]))
+    return path
+
+
+def read_manifest(path: str) -> dict:
+    with open(os.path.join(path, MANIFEST_NAME)) as f:
+        return json.load(f)
+
+
+def attach_bundle(path: str, mesh=None) -> dict:
+    """Attach an AOT bundle directory as a read-only cache overlay.
+
+    Refuses LOUDLY (raises :class:`MXNetError`) when the bundle was
+    built for a different device topology or — when ``mesh`` is given —
+    under different mesh axes: silently serving the wrong executable
+    layout is exactly the failure this check exists to stop.  A stale
+    jax/jaxlib version is a softer failure: the bundle attaches but
+    every entry invalidates at load (plain recompile) with a structured
+    event."""
+    manifest = read_manifest(path)
+    cur = env_fingerprint()
+    ent_env = manifest.get("env") or {}
+    for k in ("platform", "device_kind", "device_count", "process_count"):
+        if ent_env.get(k) != cur.get(k):
+            raise MXNetError(
+                "AOT bundle %s was built for %s=%r but this process has "
+                "%r — refusing the mismatched restore (rebuild the bundle "
+                "on this topology or serve without it)"
+                % (path, k, ent_env.get(k), cur.get(k)))
+    if mesh is not None:
+        want = {str(n): int(mesh.shape[n]) for n in mesh.axis_names}
+        for e in manifest.get("entries", []):
+            axes = e.get("mesh_axes")
+            if axes and axes != want:
+                raise MXNetError(
+                    "AOT bundle entry %s records mesh axes %s but the "
+                    "target mesh is %s — refusing the mismatched restore"
+                    % (e.get("digest"), axes, want))
+    with _lock:
+        if path not in _bundles:
+            _bundles.append(path)
+    _log_event("compile_cache_bundle_attached", path=path,
+               entries=len(manifest.get("entries", [])))
+    return manifest
+
+
+def detach_bundles() -> None:
+    with _lock:
+        del _bundles[:]
+
+
+# ---------------------------------------------------------------------------
+# admin: ls / verify / prune  (shared with tools/compile_cache_admin.py)
+# ---------------------------------------------------------------------------
+
+def ls_entries(d: str) -> List[dict]:
+    """[{digest, path, bytes, mtime, kind, compile_ms, env_ok}] for every
+    entry in ``d`` (unreadable headers report kind='corrupt')."""
+    out = []
+    if not os.path.isdir(d):
+        return out
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(ENTRY_SUFFIX):
+            continue
+        path = os.path.join(d, name)
+        st = os.stat(path)
+        rec = {"digest": name[:-len(ENTRY_SUFFIX)], "path": path,
+               "bytes": st.st_size, "mtime": st.st_mtime}
+        try:
+            meta = entry_meta(path)
+            rec.update(kind=meta.get("kind"),
+                       compile_ms=meta.get("compile_ms"),
+                       env_ok=_env_compatible(meta))
+        except Exception as exc:
+            rec.update(kind="corrupt", error=repr(exc)[:120])
+        out.append(rec)
+    return out
+
+
+def verify_entry(path: str) -> Tuple[bool, str]:
+    """(ok, detail): CRC sidecar + header + payload unpickle check —
+    everything short of loading onto devices."""
+    from .filesystem import verify_crc_sidecar
+
+    crc = verify_crc_sidecar(path)
+    if crc is False:
+        return False, "crc mismatch"
+    try:
+        meta, payload = _read_payload(path)
+        pickle.loads(payload)
+    except Exception as exc:
+        return False, "unreadable: %r" % (exc,)
+    if not _env_compatible(meta):
+        return True, "ok (stale env: recompiles on load)"
+    return True, "ok"
+
+
+def prune(d: str, budget_mb: int) -> List[str]:
+    """Delete oldest-mtime entries (and their sidecars) until the
+    directory is under ``budget_mb``.  Returns the removed paths."""
+    entries = ls_entries(d)
+    total = sum(e["bytes"] for e in entries)
+    budget = budget_mb * (1 << 20)
+    removed = []
+    for e in sorted(entries, key=lambda e: e["mtime"]):
+        if total <= budget:
+            break
+        for p in (e["path"], e["path"] + ".crc32"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        removed.append(e["path"])
+        total -= e["bytes"]
+    if removed:
+        _log_event("compile_cache_pruned", dir=d, removed=len(removed))
+    return removed

@@ -51,6 +51,17 @@ class _GraphPlan:
             n for n in self.nodes if n.op is not None and n.op.stochastic]
         self.output_entries = [(id(node), idx) for node, idx in symbol._outputs]
         self.output_names = symbol.list_outputs()
+        self._fingerprint = None
+
+    def fingerprint(self) -> str:
+        """Content hash of the graph (serialized symbol) — the
+        process-independent half of a persistent compile-cache key."""
+        if self._fingerprint is None:
+            import hashlib
+
+            self._fingerprint = hashlib.sha256(
+                self.symbol.tojson().encode()).hexdigest()[:16]
+        return self._fingerprint
 
     def placement_map(self, group2ctx):
         """Node-id → jax.Device from ``__ctx_group__`` attrs (reference:
@@ -279,7 +290,13 @@ class Executor:
                 return plan.run(cast(args), aux, rng, is_train,
                                 want_internals=internals, placement=placement)
 
-            self._jit_cache[key] = fn if self._naive else jax.jit(fn)
+            if self._naive:
+                self._jit_cache[key] = fn
+            else:
+                from . import compile_cache as _cc
+
+                self._jit_cache[key] = _cc.maybe_cached(
+                    jax.jit(fn), "fwd", key, self)
         return self._jit_cache[key]
 
     def _get_fwd_bwd(self, is_train: bool, diff_names: tuple, add_names: tuple):
@@ -313,7 +330,13 @@ class Executor:
                     grads[name] = grads[name] + old_grads[name]
                 return list(outs), new_aux, grads
 
-            self._jit_cache[key] = fn if self._naive else jax.jit(fn)
+            if self._naive:
+                self._jit_cache[key] = fn
+            else:
+                from . import compile_cache as _cc
+
+                self._jit_cache[key] = _cc.maybe_cached(
+                    jax.jit(fn), "fwdbwd", key, self)
         return self._jit_cache[key]
 
     # ------------------------------------------------------------------
@@ -383,7 +406,7 @@ class Executor:
         return in_s, out_s
 
     def _get_fused_step(self, key, update_infos, pure_update, needs_rng,
-                        shardings=None):
+                        shardings=None, stable_key=None):
         """Jitted forward+backward+update with donated param/state/aux
         buffers.  This is the whole of the reference's per-batch engine
         traffic (GraphExecutor::Forward/Backward + the kvstore push/pull +
@@ -433,12 +456,31 @@ class Executor:
 
             if self._naive:
                 self._jit_cache[key] = fn
-            elif shardings is not None:
-                self._jit_cache[key] = jax.jit(
-                    fn, donate_argnums=(0, 1, 2),
-                    in_shardings=shardings[0], out_shardings=shardings[1])
             else:
-                self._jit_cache[key] = jax.jit(fn, donate_argnums=(0, 1, 2))
+                from . import compile_cache as _cc
+
+                # Cache-eligible executables are built WITHOUT donation:
+                # XLA's executable deserializer can mis-bind donated
+                # (input-output aliased) arguments that share a shape, so
+                # an entry compiled here must stay correct when another
+                # process deserializes it.  The default (cache off) keeps
+                # in-place buffer reuse.
+                donate = () if _cc.active() else (0, 1, 2)
+                if shardings is not None:
+                    jfn = jax.jit(
+                        fn, donate_argnums=donate,
+                        in_shardings=shardings[0],
+                        out_shardings=shardings[1])
+                else:
+                    jfn = jax.jit(fn, donate_argnums=donate)
+                # the persistent key uses stable_key (no object ids) so a
+                # fresh process — or a fresh optimizer instance with the
+                # same hypers — maps to the same disk entry; donation
+                # changes the compiled program, so it is part of the key
+                if stable_key is not None:
+                    stable_key = stable_key + (("donate", tuple(donate)),)
+                self._jit_cache[key] = _cc.maybe_cached(
+                    jfn, "fused", stable_key, self)
         return self._jit_cache[key]
 
     def fused_step(self, optimizer, updater, param_names):
@@ -515,13 +557,21 @@ class Executor:
                hypers, float(optimizer.rescale_grad),
                float(optimizer.clip_gradient or 0.0),
                self._shard_fingerprint)
+        # the same key with every process-unstable part (object ids, shard
+        # fingerprint — the compile cache derives a stable one from the
+        # mesh itself) removed: what the persistent compile cache keys on
+        stable_key = ("fused", tuple(infos), type(optimizer).__name__,
+                      hypers, float(optimizer.rescale_grad),
+                      float(optimizer.clip_gradient or 0.0),
+                      bool(optimizer.needs_rng))
         first_build = key not in self._jit_cache
         shardings = None
         if self._shard_mesh is not None and not self._naive and first_build:
             shardings = self._fused_shardings(diff_args, states, aux,
                                               other_args)
         fn = self._get_fused_step(key, tuple(infos), optimizer.pure_update,
-                                  optimizer.needs_rng, shardings)
+                                  optimizer.needs_rng, shardings,
+                                  stable_key=stable_key)
         if first_build and not self._naive:
             # introspection hook (compile-miss path only — zero per-step
             # cost): abstract arg signature of the fused call, so
@@ -537,6 +587,12 @@ class Executor:
         with _prof.Frame("Executor.fused_step", "exec"):
             outs, new_aux, new_params, new_states = fn(
                 diff_args, states, aux, other_args, rng, sc, opt_rng)
+        if first_build and not self._naive:
+            # when the compile cache primed this executable, XLA's cost
+            # analysis rode along (entry meta on hits, read once from the
+            # fresh Compiled on misses) — StepMonitor consumes this instead
+            # of re-lowering+re-compiling the program
+            self._fused_cost_info = getattr(fn, "cost_info", None)
 
         for name, idx, _, _ in infos:
             self.arg_dict[name]._set(new_params[name])

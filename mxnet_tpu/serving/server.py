@@ -108,12 +108,57 @@ class InferenceServer:
             self.start()
 
     @classmethod
-    def from_checkpoint(cls, prefix, epoch, input_shapes, **kwargs):
+    def from_checkpoint(cls, prefix, epoch, input_shapes, attach_aot=True,
+                        **kwargs):
         """Serve ``save_checkpoint`` files directly (the file pair
-        ``Predictor.from_checkpoint`` consumes)."""
+        ``Predictor.from_checkpoint`` consumes).
+
+        When an AOT bundle (``prefix-NNNN.aot/``, written by
+        :meth:`save_aot_bundle`) sits beside the params and
+        ``attach_aot`` is True it is attached as a read-only
+        compile-cache overlay BEFORE warmup, so every bucket warms by
+        deserializing its executable instead of compiling it.  A bundle
+        built for a different device topology raises
+        :class:`MXNetError` (pass ``attach_aot=False`` to serve without
+        it)."""
+        if attach_aot:
+            from ..checkpoint import attach_aot_bundle
+
+            attach_aot_bundle(prefix, epoch)
         return cls("%s-symbol.json" % prefix,
                    "%s-%04d.params" % (prefix, epoch),
                    input_shapes, **kwargs)
+
+    def compiled_entries(self):
+        """Primed compile-cache wrappers across every replica and bucket
+        (empty unless ``MXNET_COMPILE_CACHE_DIR`` is set or a bundle is
+        attached)."""
+        out = []
+        for rep in self._replicas:
+            out.extend(rep.compiled_entries())
+        return out
+
+    def save_aot_bundle(self, prefix, epoch):
+        """Write this server's compiled executables as an AOT bundle
+        beside the checkpoint (``prefix-NNNN.aot/``) with a warmup
+        manifest, so the next replica restored from this prefix warms
+        deserialize-only.  Requires the compile cache to be enabled (the
+        executables must have primed through it)."""
+        from ..checkpoint import save_aot_bundle as _save
+
+        entries = self.compiled_entries()
+        if not entries:
+            raise MXNetError(
+                "no cached executables to bundle — set "
+                "MXNET_COMPILE_CACHE_DIR before building the server so "
+                "its buckets prime through the compile cache")
+        warmup = {
+            "input_shapes": {k: list(v)
+                             for k, v in self._input_shapes.items()},
+            "buckets": list(self.buckets),
+            "dtype": self._dtype.name,
+        }
+        return _save(prefix, epoch, entries, warmup=warmup)
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
@@ -239,7 +284,13 @@ class InferenceServer:
         predictors.  The batch in flight finishes on the old weights;
         the very next flush runs the new ones.  The server keeps
         accepting and serving requests throughout — readiness never
-        drops.  Serialized: concurrent ``swap`` calls queue up."""
+        drops.  Serialized: concurrent ``swap`` calls queue up.
+
+        With the compile cache enabled the shadow predictors inherit the
+        outgoing replica's executables (same graph + shapes -> same
+        content fingerprint, served from the in-process cache), so the
+        shadow warmup performs zero fresh XLA compiles — swap latency is
+        parameter-loading, not compilation."""
         from .. import faults
 
         faults.fire("serving.server.swap")

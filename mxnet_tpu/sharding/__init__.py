@@ -32,7 +32,7 @@ Env knobs (see docs/how_to/sharding.md):
 """
 from ..base import register_env
 
-from .mesh import MeshConfig, build_mesh, mesh_axes
+from .mesh import MeshConfig, build_mesh, mesh_axes, mesh_fingerprint
 from .rules import (PartitionRules, PRESETS, as_rules,
                     explain_partition_rules, get_preset,
                     match_partition_rules)
@@ -40,7 +40,7 @@ from .placement import (gather_params, make_shardings, param_bytes, place,
                         shard_params, spec_shard_factor, validate_specs)
 
 __all__ = [
-    "MeshConfig", "build_mesh", "mesh_axes",
+    "MeshConfig", "build_mesh", "mesh_axes", "mesh_fingerprint",
     "PartitionRules", "PRESETS", "as_rules", "get_preset",
     "match_partition_rules", "explain_partition_rules",
     "shard_params", "gather_params", "make_shardings", "place",

@@ -19,7 +19,7 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["MeshConfig", "build_mesh", "mesh_axes"]
+__all__ = ["MeshConfig", "build_mesh", "mesh_axes", "mesh_fingerprint"]
 
 AxisSpec = Union[Tuple[str, int], Sequence]
 
@@ -164,3 +164,14 @@ def build_mesh(axes="data=-1", devices=None):
 def mesh_axes(mesh) -> Dict[str, int]:
     """``{axis_name: size}`` for a Mesh (insertion-ordered)."""
     return {name: int(mesh.shape[name]) for name in mesh.axis_names}
+
+
+def mesh_fingerprint(mesh) -> Tuple:
+    """Stable, process-independent identity of a mesh's layout: ordered
+    (axis, size) pairs plus the flattened device ids.  Unlike ``id(mesh)``
+    this survives pickling boundaries, so it is what the persistent
+    compile cache keys sharded executables by."""
+    axes = tuple((str(n), int(mesh.shape[n])) for n in mesh.axis_names)
+    dev_ids = tuple(int(d.id) for d in np.asarray(
+        mesh.devices, dtype=object).reshape(-1))
+    return (axes, dev_ids)

@@ -40,7 +40,8 @@ from .step_monitor import (RecompileWarning, StepMonitor, fused_cost_analysis,
                            lower_and_analyze, peak_flops)
 
 __all__ = [
-    "enabled", "enable", "disable", "registry", "counter", "gauge",
+    "enabled", "enable", "disable", "dump_dir", "registry", "counter",
+    "gauge",
     "histogram", "labeled_counter", "log_event", "events", "event_log",
     "span", "dump_trace", "merged_trace", "validate_trace",
     "render_prometheus", "register_collector", "summary",
@@ -64,6 +65,10 @@ register_env("MXNET_TELEMETRY_TRACE_BUFFER", 65536, int,
 register_env("MXNET_TELEMETRY_DIR", "", str,
              "Directory for the JSONL structured-event log "
              "(events.jsonl); empty keeps events in memory only.")
+register_env("MXNET_TELEMETRY_DUMP_DIR", "", str,
+             "Directory for telemetry artifacts (exit-time trace-*.json, "
+             "flight-recorder postmortems when their own dirs are unset); "
+             "empty uses <tmpdir>/mxnet_tpu-artifacts — never the cwd.")
 register_env("MXNET_TELEMETRY_MFU", 1, int,
              "Run XLA cost analysis once per compiled fused step to "
              "derive achieved MFU (0 skips the per-compile analysis).")
@@ -87,6 +92,19 @@ dump_trace = tracer.dump_trace
 
 def enabled() -> bool:
     return _ENABLED
+
+
+def dump_dir() -> str:
+    """Where telemetry artifacts (traces, postmortems without an explicit
+    dir) land: ``MXNET_TELEMETRY_DUMP_DIR``, defaulting to a per-tmpdir
+    artifacts directory.  Deliberately NEVER the cwd — test and bench
+    runs must not litter the working tree."""
+    d = env("MXNET_TELEMETRY_DUMP_DIR", "", str)
+    if not d:
+        import tempfile
+
+        d = os.path.join(tempfile.gettempdir(), "mxnet_tpu-artifacts")
+    return d
 
 
 def registry() -> Registry:
@@ -152,8 +170,11 @@ def _atexit_flush():
     if not _ENABLED:
         return
     distributed.push_once()
-    d = env("MXNET_TELEMETRY_DIR", "", str)
-    if d and tracer.active():
+    # trace routing: an explicit MXNET_TELEMETRY_DIR keeps its contract
+    # (trace_merge stitches from there); otherwise traces go to the
+    # artifacts dump dir — never the cwd
+    d = env("MXNET_TELEMETRY_DIR", "", str) or dump_dir()
+    if tracer.active():
         try:
             os.makedirs(d, exist_ok=True)
             dump_trace(os.path.join(
@@ -209,7 +230,8 @@ def _reset_for_tests() -> None:
     # instrumented modules cache registry handles lazily; stale handles
     # would keep writing to the dropped registry
     for modname, attr in (("mxnet_tpu.io", "_PREFETCH_TELEM"),
-                          ("mxnet_tpu.kvstore_server", "_TELEM")):
+                          ("mxnet_tpu.kvstore_server", "_TELEM"),
+                          ("mxnet_tpu.compile_cache", "_instruments")):
         m = sys.modules.get(modname)
         if m is not None:
             setattr(m, attr, None)

@@ -39,7 +39,9 @@ register_env("MXNET_TELEMETRY_FLIGHT_RING", 256, int,
              "dump (the in-memory rings may hold more).")
 register_env("MXNET_TELEMETRY_POSTMORTEM_DIR", "", str,
              "Directory for flight-recorder postmortem dumps; empty "
-             "falls back to MXNET_TELEMETRY_DIR, then the cwd.")
+             "falls back to MXNET_TELEMETRY_DUMP_DIR, then "
+             "MXNET_TELEMETRY_DIR, then <tmpdir>/mxnet_tpu-artifacts "
+             "(never the cwd).")
 
 _lock = threading.Lock()
 _in_dump = False
@@ -51,8 +53,11 @@ def last_path() -> Optional[str]:
 
 
 def _postmortem_dir() -> str:
+    from . import dump_dir
+
     return env("MXNET_TELEMETRY_POSTMORTEM_DIR", "", str) or \
-        env("MXNET_TELEMETRY_DIR", "", str) or "."
+        env("MXNET_TELEMETRY_DUMP_DIR", "", str) or \
+        env("MXNET_TELEMETRY_DIR", "", str) or dump_dir()
 
 
 def dump(reason: str, extra: Optional[dict] = None) -> Optional[str]:
@@ -89,7 +94,9 @@ def dump(reason: str, extra: Optional[dict] = None) -> Optional[str]:
         try:
             os.makedirs(d, exist_ok=True)
         except OSError:
-            d = "."
+            import tempfile
+
+            d = tempfile.gettempdir()
         path = os.path.join(d, "postmortem-%s-%d.json"
                             % (proc_label(), int(time.time() * 1e3)))
         tmp = "%s.tmp.%d" % (path, os.getpid())

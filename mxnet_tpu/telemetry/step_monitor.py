@@ -60,7 +60,16 @@ def lower_and_analyze(fn, abstract):
 
 
 def fused_cost_analysis(executor):
-    """Cost analysis of an executor's last-compiled fused step, or None."""
+    """Cost analysis of an executor's last-compiled fused step, or None.
+
+    When the persistent compile cache primed the step it already carries
+    XLA's cost analysis (read once from the fresh executable on a miss,
+    or from the cache-entry metadata on a hit) — use that and skip the
+    re-lower+re-compile entirely, which is what keeps a warm-cache cold
+    start at zero compiler invocations even with telemetry on."""
+    info = getattr(executor, "_fused_cost_info", None)
+    if info and info.get("flops"):
+        return info
     fn, abstract = getattr(executor, "_fused_introspect", (None, None))
     _, info = lower_and_analyze(fn, abstract)
     return info

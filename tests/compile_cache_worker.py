@@ -1,0 +1,100 @@
+"""Subprocess worker for the compile-cache cross-process tests.
+
+One process = one cold start.  Builds a deterministic tiny model, runs it
+(a Predictor forward or a short fused-step training run), and prints ONE
+json line: ``{"digest": ..., "stats": compile_cache.stats()}``.
+
+The parent runs this twice against one ``MXNET_COMPILE_CACHE_DIR``:
+process A must compile-and-store (misses > 0), process B must start warm
+(hits > 0, misses == 0) and produce a bit-identical ``digest`` — the
+executable it deserialized stands in for the one A compiled.
+
+Usage: python tests/compile_cache_worker.py {predict|train}
+       (cache dir comes from MXNET_COMPILE_CACHE_DIR; empty = cache off)
+"""
+import hashlib
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+D, HID, K, BATCH = 6, 8, 3, 8
+
+
+def _mlp():
+    import mxnet_tpu as mx
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=HID,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=K, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _params(seed=5):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return {
+        "fc1_weight": rng.randn(HID, D).astype(np.float32) * 0.3,
+        "fc1_bias": np.zeros(HID, np.float32),
+        "fc2_weight": rng.randn(K, HID).astype(np.float32) * 0.3,
+        "fc2_bias": np.zeros(K, np.float32),
+    }
+
+
+def run_predict():
+    import numpy as np
+    import mxnet_tpu as mx
+
+    pred = mx.Predictor(_mlp(), {k: mx.nd.array(v)
+                                 for k, v in _params().items()},
+                        {"data": (2, D)})
+    X = np.linspace(-1.0, 1.0, 2 * D, dtype=np.float32).reshape(2, D)
+    out = pred.forward(data=X)[0].asnumpy()
+    return hashlib.sha256(np.ascontiguousarray(out).tobytes()).hexdigest()
+
+
+def run_train():
+    import numpy as np
+    import mxnet_tpu as mx
+
+    mod = mx.mod.Module(_mlp(), label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (BATCH, D))],
+             label_shapes=[("softmax_label", (BATCH,))])
+    arg_params = {k: mx.nd.array(v) for k, v in _params().items()}
+    mod.init_params(arg_params=arg_params, aux_params={},
+                    allow_missing=False)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(11)
+    for _ in range(3):
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(rng.randn(BATCH, D).astype(np.float32))],
+            label=[mx.nd.array(
+                rng.randint(0, K, size=BATCH).astype(np.float32))])
+        mod.forward_backward(batch)
+        mod.update()
+    final, _ = mod.get_params()
+    h = hashlib.sha256()
+    for name in sorted(final):
+        h.update(name.encode())
+        h.update(np.ascontiguousarray(final[name].asnumpy()).tobytes())
+    return h.hexdigest()
+
+
+def main(argv=None):
+    mode = (argv or sys.argv[1:])[0]
+    from mxnet_tpu import compile_cache
+
+    digest = {"predict": run_predict, "train": run_train}[mode]()
+    print(json.dumps({"digest": digest, "stats": compile_cache.stats()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,15 +41,26 @@ def emit_partial(reason):
     record: everything measured so far is already on stdout (one line per
     phase), so this marks the run explicitly incomplete — with whatever
     telemetry summary the process accumulated — instead of leaving a
-    truncated log a reader has to diagnose."""
+    truncated log a reader has to diagnose.  Compile-time and
+    compile-cache hit/miss counters ride along so even a run the tunnel
+    killed mid-compile still yields cold-start evidence."""
     summary = None
+    cache = None
     try:
         from mxnet_tpu import telemetry
 
         summary = telemetry.summary() or None
     except Exception:
         pass
-    emit("partial", reason=reason, telemetry=summary)
+    try:
+        from mxnet_tpu import compile_cache
+
+        cache = compile_cache.stats()
+        if not any((cache["hits"], cache["misses"], cache["errors"])):
+            cache = None
+    except Exception:
+        pass
+    emit("partial", reason=reason, telemetry=summary, compile_cache=cache)
 
 
 def main():
