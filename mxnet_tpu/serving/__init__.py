@@ -11,15 +11,22 @@ chrome-trace profiler — and, over N such replicas, a resilient
 circuit breakers, bounded retry + hedging, per-SLO admission classes,
 and zero-downtime checkpoint hot-swap.
 """
-from .batcher import (BucketedPredictor, DeadlineExceededError, MicroBatcher,
-                      QueueFullError, ServerClosedError, pow2_buckets)
+from .autoscaler import Autoscaler, LocalCheckpointProvider, ProcessProvider
+from .batcher import (BucketedPredictor, DeadlineExceededError,
+                      DrainTimeoutError, MicroBatcher, QueueFullError,
+                      ServerClosedError, pow2_buckets)
 from .metrics import ServingMetrics
+from .registry import ReplicaRegistry, RegistryClient, start_heartbeater
 from .router import (NoReplicaAvailableError, Router, RouterError,
                      RouterMetrics, RouterOverloadError, SLOClass)
-from .server import InferenceServer
+from .server import InferenceServer, install_preemption_handler
 
 __all__ = ["InferenceServer", "BucketedPredictor", "MicroBatcher",
            "ServingMetrics", "pow2_buckets", "QueueFullError",
            "DeadlineExceededError", "ServerClosedError",
+           "DrainTimeoutError",
            "Router", "SLOClass", "RouterMetrics", "RouterError",
-           "NoReplicaAvailableError", "RouterOverloadError"]
+           "NoReplicaAvailableError", "RouterOverloadError",
+           "ReplicaRegistry", "RegistryClient", "start_heartbeater",
+           "Autoscaler", "LocalCheckpointProvider", "ProcessProvider",
+           "install_preemption_handler"]

@@ -29,7 +29,8 @@ from ..base import MXNetError
 from .. import profiler
 
 __all__ = ["pow2_buckets", "BucketedPredictor", "MicroBatcher",
-           "QueueFullError", "DeadlineExceededError", "ServerClosedError"]
+           "QueueFullError", "DeadlineExceededError", "ServerClosedError",
+           "DrainTimeoutError"]
 
 
 class QueueFullError(MXNetError):
@@ -42,6 +43,13 @@ class DeadlineExceededError(MXNetError):
 
 class ServerClosedError(MXNetError):
     """The server is stopped (or stopping) and not accepting work."""
+
+
+class DrainTimeoutError(MXNetError):
+    """The drain deadline expired with work still outstanding: a wedged
+    batcher worker must not hang retirement forever, so the remaining
+    futures are force-cancelled with this typed error (callers retry on
+    another replica)."""
 
 
 def pow2_buckets(max_batch_size: int) -> tuple:
@@ -199,6 +207,7 @@ class MicroBatcher:
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._closed = False
+        self._inflight: set = set()  # _WorkItems dequeued but unfinished
         self._dead_workers: List[str] = []  # "name: exc" per crashed worker
         self._workers = [
             threading.Thread(target=self._run, args=(i,),
@@ -256,7 +265,15 @@ class MicroBatcher:
     def stop(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop accepting work; with ``drain`` the workers flush whatever
         is queued before exiting, otherwise pending futures fail with
-        :class:`ServerClosedError`."""
+        :class:`ServerClosedError`.
+
+        ``timeout`` (seconds) is a HARD drain deadline: if the workers
+        have not flushed by then — a wedged executor, a worker stuck in a
+        hung backend call — every still-pending future (queued or
+        mid-batch) is force-cancelled with :class:`DrainTimeoutError`
+        instead of hanging retirement forever.  ``None`` waits
+        indefinitely (the legacy behaviour; :class:`InferenceServer`
+        always passes its ``MXNET_SERVING_DRAIN_TIMEOUT_MS`` budget)."""
         with self._cv:
             self._closed = True
             if not drain:
@@ -267,8 +284,43 @@ class MicroBatcher:
                     self._metrics.on_fail()
             self._cv.notify_all()
         if self._started:
+            deadline = (time.monotonic() + timeout
+                        if timeout is not None else None)
             for w in self._workers:
-                w.join(timeout)
+                w.join(timeout if deadline is None
+                       else max(0.0, deadline - time.monotonic()))
+            if drain and any(w.is_alive() for w in self._workers):
+                self._force_cancel()
+
+    def _force_cancel(self):
+        """Drain deadline expired: fail every future still outstanding
+        (queued or dequeued-but-unfinished) with the typed drain error.
+        The wedged worker may eventually finish its batch — ``_execute``
+        guards every ``set_result`` with ``done()`` so a late completion
+        is dropped, never raised."""
+        exc = DrainTimeoutError(
+            "drain deadline exceeded with a worker still busy; "
+            "outstanding requests force-cancelled")
+        cancelled = 0
+        with self._cv:
+            while self._q:
+                item = self._q.popleft()
+                if not item.future.done():
+                    item.future.set_exception(exc)
+                    cancelled += 1
+            for item in list(self._inflight):
+                if not item.future.done():
+                    item.future.set_exception(exc)
+                    cancelled += 1
+            self._inflight.clear()
+            self._cv.notify_all()
+        if cancelled:
+            self._metrics.on_fail(cancelled)
+            from .. import telemetry as _tm
+
+            _tm.log_event("serving_drain_timeout", cancelled=cancelled,
+                          dead_workers=self.dead_workers())
+        return cancelled
 
     # -- worker side ------------------------------------------------------
     def _collect(self):
@@ -292,6 +344,7 @@ class MicroBatcher:
             batch = []
             while self._q and len(batch) < self.max_batch_size:
                 batch.append(self._q.popleft())
+            self._inflight.update(batch)
             self._metrics.on_dequeue(len(self._q))
             return batch
 
@@ -318,9 +371,18 @@ class MicroBatcher:
             raise
 
     def _execute(self, replica, batch):
+        try:
+            self._execute_inner(replica, batch)
+        finally:
+            with self._cv:
+                self._inflight.difference_update(batch)
+
+    def _execute_inner(self, replica, batch):
         now = time.monotonic()
         live = []
         for item in batch:
+            if item.future.done():
+                continue  # force-cancelled by a drain deadline
             if item.deadline is not None and now > item.deadline:
                 item.future.set_exception(DeadlineExceededError(
                     "request waited past its deadline"))
@@ -338,8 +400,11 @@ class MicroBatcher:
             self._metrics.on_batch(bucket, n)
             done = time.monotonic()
             for item, res in zip(live, results):
-                item.future.set_result(res)
-                self._metrics.on_complete((done - item.t_enqueue) * 1e3)
+                # a drain-deadline force-cancel may have failed this
+                # future already; a late completion is dropped, not raised
+                if not item.future.done():
+                    item.future.set_result(res)
+                    self._metrics.on_complete((done - item.t_enqueue) * 1e3)
         except Exception as exc:  # propagate to every waiting caller
             self._metrics.on_fail(len(live))
             for item in live:

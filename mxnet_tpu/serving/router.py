@@ -99,6 +99,13 @@ register_env("MXNET_SERVING_REMOTE_CAPACITY", 256, int,
              "Assumed queue capacity of a remote replica for the "
              "pressure estimate (local replicas report their real "
              "max_queue).")
+register_env("MXNET_SERVING_PROBE_FAILURES", 3, int,
+             "Consecutive background-probe failures before a remote "
+             "replica's cached health/readiness flips to down — one "
+             "slow /healthz under load must not flap the breaker.")
+register_env("MXNET_SERVING_REGISTRY_SYNC_MS", 500.0, float,
+             "Period at which a registry-attached router re-syncs its "
+             "replica set against the shared live set.")
 
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
@@ -329,6 +336,9 @@ class _Replica:
         self.inflight = 0
         self.ewma_ms = 0.0
         self.calls = 0
+        # scale-in / hot-removal: a draining replica finishes its
+        # in-flight work but never receives a new dispatch
+        self.draining = False
 
     # -- breaker -----------------------------------------------------------
     def _transition(self, state):
@@ -338,6 +348,8 @@ class _Replica:
                              state=state)
 
     def routable(self, now) -> bool:
+        if self.draining:
+            return False
         with self._lock:
             if self.state == BREAKER_OPEN and \
                     now - self._opened_at >= self._router.breaker_cooldown_s:
@@ -348,6 +360,28 @@ class _Replica:
             if self.state == BREAKER_HALF_OPEN and self._probe_inflight:
                 return False  # one probe at a time
         return self.ready()
+
+    def try_reserve(self) -> bool:
+        """Claim the right to dispatch one request here.  CLOSED admits
+        everything; HALF_OPEN atomically admits exactly ONE probe —
+        ``routable`` alone cannot enforce that, because two dispatcher
+        threads may both read half-open+idle before either begins its
+        call (the classic check-then-act race).  The reservation is
+        released by ``end_call`` (any outcome) or ``release``."""
+        with self._lock:
+            if self.state == BREAKER_OPEN:
+                return False
+            if self.state == BREAKER_HALF_OPEN:
+                if self._probe_inflight:
+                    return False
+                self._probe_inflight = True
+            return True
+
+    def release(self):
+        """Undo a ``try_reserve`` that never became a call."""
+        with self._lock:
+            if self.state == BREAKER_HALF_OPEN:
+                self._probe_inflight = False
 
     def begin_call(self):
         with self._lock:
@@ -394,7 +428,8 @@ class _Replica:
         return {"name": self.name, "kind": self.kind, "state": self.state,
                 "ready": self.ready(), "inflight": self.inflight,
                 "ewma_ms": round(self.ewma_ms, 3), "calls": self.calls,
-                "queue_depth": self.queue_depth()}
+                "queue_depth": self.queue_depth(),
+                "draining": self.draining}
 
     # -- backend interface -------------------------------------------------
     def ready(self) -> bool:
@@ -462,6 +497,12 @@ class _RemoteReplica(_Replica):
         self._base = "http://%s" % addr
         self._probe_ready = None  # cached by the background probe thread
         self._probe_alive = None
+        # debounce: one slow /healthz under load must not flap the
+        # replica out of rotation — K consecutive failures flip it down,
+        # one success flips it straight back up
+        self._probe_k = max(1, env("MXNET_SERVING_PROBE_FAILURES", 3, int))
+        self._alive_misses = 0
+        self._ready_misses = 0
 
     def _get(self, path, timeout=2.0):
         import urllib.request
@@ -471,16 +512,36 @@ class _RemoteReplica(_Replica):
             return resp.status
 
     def _probe(self):
-        """Refresh the cached liveness/readiness (background thread)."""
+        """Refresh the cached liveness/readiness (background thread).
+        Success is believed immediately; failure only after
+        ``MXNET_SERVING_PROBE_FAILURES`` consecutive misses — except
+        while the cache is still unset (first contact), where a miss
+        counts at once so a never-up backend is not routed to."""
         faults.fire("serving.replica.probe")
         try:
-            self._probe_alive = self._get("/healthz") == 200
+            ok = self._get("/healthz") == 200
         except Exception:
-            self._probe_alive = False
+            ok = False
+        if ok:
+            self._alive_misses = 0
+            self._probe_alive = True
+        else:
+            self._alive_misses += 1
+            if self._probe_alive is None or \
+                    self._alive_misses >= self._probe_k:
+                self._probe_alive = False
         try:
-            self._probe_ready = self._get("/readyz") == 200
+            ok = self._get("/readyz") == 200
         except Exception:
-            self._probe_ready = False
+            ok = False
+        if ok:
+            self._ready_misses = 0
+            self._probe_ready = True
+        else:
+            self._ready_misses += 1
+            if self._probe_ready is None or \
+                    self._ready_misses >= self._probe_k:
+                self._probe_ready = False
 
     def ready(self):
         if self._probe_ready is None:
@@ -558,18 +619,28 @@ class Router:
     seed : int
         Seeds the power-of-two-choices RNG, so a chaos run's dispatch
         sequence is reproducible.
+    registry : ReplicaRegistry | RegistryClient, optional
+        A shared replica live-set (``serving.registry``).  The router
+        syncs its replica set against it in the background
+        (``MXNET_SERVING_REGISTRY_SYNC_MS``): members it has never seen
+        are added, members that left or were evicted are drained and
+        removed.  N routers attached to one registry converge on the
+        same fleet — the front door stops being a single point of
+        failure.  With a registry, ``backends`` may be empty.
     """
 
-    def __init__(self, backends: Sequence[Union[InferenceServer, str]],
+    def __init__(self, backends: Sequence[Union[InferenceServer, str]] = (),
                  slo_classes: Optional[Dict[str, SLOClass]] = None,
                  retries: Optional[int] = None,
                  breaker_threshold: Optional[int] = None,
                  breaker_cooldown_ms: Optional[float] = None,
                  hedge_ms: Optional[float] = None,
                  shed_pressure: Optional[float] = None,
-                 workers: Optional[int] = None, seed: int = 0):
-        if not backends:
-            raise ValueError("need at least one backend replica")
+                 workers: Optional[int] = None, seed: int = 0,
+                 registry=None, registry_sync_ms: Optional[float] = None):
+        if not backends and registry is None:
+            raise ValueError("need at least one backend replica "
+                             "(or a registry to discover them from)")
         self.metrics = RouterMetrics()
         self.retries = env("MXNET_SERVING_ROUTER_RETRIES", 2, int) \
             if retries is None else int(retries)
@@ -598,6 +669,7 @@ class Router:
                 self._replicas.append(_RemoteReplica(name, b, self))
             else:
                 self._replicas.append(_LocalReplica(name, b, self))
+        self._name_seq = itertools.count(len(self._replicas))
         # servers the router itself created (swap shadows): it owns their
         # lifecycle; caller-provided backends stay the caller's
         self._owned: List[InferenceServer] = []
@@ -614,6 +686,28 @@ class Router:
         self._probe_stop = threading.Event()
         self._probe_thread = None
         if any(isinstance(r, _RemoteReplica) for r in self._replicas):
+            self._ensure_probe_thread()
+        # registry-driven replica discovery (router replication): names
+        # under registry management are synced against the shared live
+        # set; constructor-passed backends stay the caller's.
+        self._registry = registry
+        self._registry_names: set = set()
+        self._registry_gen = -1
+        self._registry_stop = threading.Event()
+        self._registry_thread = None
+        if registry is not None:
+            self._registry_sync_s = (
+                env("MXNET_SERVING_REGISTRY_SYNC_MS", 500.0, float)
+                if registry_sync_ms is None else float(registry_sync_ms)
+            ) / 1e3
+            self._sync_registry()  # first sync before taking traffic
+            self._registry_thread = threading.Thread(
+                target=self._registry_loop, name="mxtpu-router-regsync",
+                daemon=True)
+            self._registry_thread.start()
+
+    def _ensure_probe_thread(self):
+        if self._probe_thread is None:
             self._probe_thread = threading.Thread(
                 target=self._probe_loop, name="mxtpu-router-probe",
                 daemon=True)
@@ -629,15 +723,143 @@ class Router:
 
     def pressure(self) -> float:
         """Aggregate backlog / aggregate queue capacity across replicas —
-        the admission-control load signal sheddable classes are gated
-        on."""
+        the admission-control load signal sheddable classes are gated on
+        (and the autoscaler's primary scale signal).  Draining replicas
+        contribute their backlog but no capacity: retiring a replica
+        must RAISE measured pressure, not mask it."""
         cap = 0
         load = 0
         for r in self.replicas():
-            cap += r.capacity()
+            if not r.draining:
+                cap += r.capacity()
             load += (r.queue_depth() if isinstance(r, _LocalReplica)
                      else r.inflight)
         return (load / cap) if cap else 1.0
+
+    # -- dynamic topology (autoscaler + registry sync) ---------------------
+    def add_replica(self, backend, name: Optional[str] = None) -> str:
+        """Put a new backend into rotation; returns its replica name.
+        The autoscaler's scale-out actuation and the registry sync both
+        land here."""
+        if self._closed:
+            raise ServerClosedError("router is closed")
+        with self._lock:
+            if name is None:
+                name = "r%d" % next(self._name_seq)
+            if any(r.name == name for r in self._replicas):
+                raise MXNetError("replica name %r already in rotation"
+                                 % name)
+            if isinstance(backend, str):
+                rep = _RemoteReplica(name, backend, self)
+            else:
+                rep = _LocalReplica(name, backend, self)
+            self._replicas.append(rep)
+        if isinstance(rep, _RemoteReplica):
+            self._ensure_probe_thread()
+        _telemetry.log_event("router_topology", op="add", replica=name,
+                             replica_kind=rep.kind)
+        self._update_topology_metrics()
+        return name
+
+    def remove_replica(self, name: str, drain: bool = True,
+                       drain_timeout_ms: Optional[float] = None,
+                       wait: bool = True):
+        """Take one replica out of rotation.  It is flipped to draining
+        first (no new dispatch; requests in flight finish), then dropped
+        from the set once idle or when the drain deadline
+        (``MXNET_SERVING_DRAIN_TIMEOUT_MS``) expires — a wedged replica
+        must not hang retirement forever.  With ``wait=False`` the
+        drain-then-drop runs in a background thread (the registry sync
+        path, which must stay responsive).  Returns the removed
+        replica's backend (or None for ``wait=False`` / unknown
+        names)."""
+        with self._lock:
+            rep = next((r for r in self._replicas if r.name == name), None)
+            if rep is None:
+                return None
+            rep.draining = True
+        _telemetry.log_event("router_topology", op="drain", replica=name)
+
+        def _finish():
+            if drain:
+                deadline = time.monotonic() + (
+                    env("MXNET_SERVING_DRAIN_TIMEOUT_MS", 30000.0, float)
+                    if drain_timeout_ms is None else float(drain_timeout_ms)
+                ) / 1e3
+                while time.monotonic() < deadline:
+                    if rep.inflight == 0 and rep.queue_depth() == 0:
+                        break
+                    time.sleep(0.01)
+            with self._lock:
+                if rep in self._replicas:
+                    self._replicas.remove(rep)
+            _telemetry.log_event("router_topology", op="remove",
+                                 replica=name, replica_kind=rep.kind)
+            self._update_topology_metrics()
+            return (rep.server if isinstance(rep, _LocalReplica)
+                    else rep.addr)
+
+        if wait:
+            return _finish()
+        threading.Thread(target=_finish, name="mxtpu-router-drain-%s" % name,
+                         daemon=True).start()
+        return None
+
+    def _sync_registry(self):
+        """One reconciliation pass against the shared registry: add
+        members this router has never seen, drain-and-remove the ones
+        that deregistered or were evicted.  Gen-gated, so the steady
+        state costs one integer fetch."""
+        try:
+            live = self._registry.live()
+        except Exception:
+            return  # registry blip: keep serving the last-known fleet
+        if live["gen"] == self._registry_gen:
+            return
+        self._registry_gen = live["gen"]
+        current = {r.name for r in self.replicas()}
+        for name, backend in live["replicas"].items():
+            if name not in current:
+                try:
+                    self.add_replica(backend, name=name)
+                except MXNetError:
+                    pass  # raced another sync pass
+                self._registry_names.add(name)
+        for name in sorted(self._registry_names - set(live["replicas"])):
+            self._registry_names.discard(name)
+            self.remove_replica(name, wait=False)
+
+    def _registry_loop(self):
+        while not self._registry_stop.wait(self._registry_sync_s):
+            self._sync_registry()
+
+    def signals(self) -> dict:
+        """The autoscaler's input: one consistent snapshot of the
+        pressure/SLO/breaker/shed signals this router already exports as
+        telemetry."""
+        reps = self.replicas()
+        now = time.monotonic()
+        snap = self.metrics.snapshot()
+        p99 = {}
+        budget = {}
+        for slo, cls in self.slo_classes.items():
+            if cls.deadline_ms is not None:
+                v = self.metrics.latency_quantile(0.99, slo)
+                if v is not None:
+                    p99[slo] = v
+                    budget[slo] = cls.deadline_ms
+        return {
+            "pressure": self.pressure(),
+            "replicas": len(reps),
+            "ready": sum(1 for r in reps if r.routable(now)),
+            "draining": sum(1 for r in reps if r.draining),
+            "breakers_open": sum(1 for r in reps
+                                 if r.state != BREAKER_CLOSED),
+            "shed_total": sum(snap["shed"].values()),
+            "expired_total": sum(snap["expired"].values()),
+            "p99_ms": p99,
+            "deadline_ms": budget,
+        }
 
     def _update_topology_metrics(self, pressure=None):
         reps = self.replicas()
@@ -698,17 +920,24 @@ class Router:
 
     def _pick(self, tried, now=None) -> Optional[_Replica]:
         """Power-of-two-choices over routable replicas not yet tried for
-        this request: sample two, take the lower load score."""
+        this request: sample two, take the lower load score.  The chosen
+        replica is atomically reserved (``try_reserve``) so a half-open
+        breaker admits exactly ONE probe even when many dispatcher
+        threads race the pick."""
         now = time.monotonic() if now is None else now
         cands = [r for r in self.replicas()
                  if r.name not in tried and r.routable(now)]
-        if not cands:
-            return None
-        if len(cands) == 1:
-            return cands[0]
-        with self._lock:
-            a, b = self._rng.sample(cands, 2)
-        return a if a.score() <= b.score() else b
+        while cands:
+            if len(cands) == 1:
+                choice = cands[0]
+            else:
+                with self._lock:
+                    a, b = self._rng.sample(cands, 2)
+                choice = a if a.score() <= b.score() else b
+            if choice.try_reserve():
+                return choice
+            cands.remove(choice)  # lost the probe-slot race; next best
+        return None
 
     def _call_replica(self, rep: _Replica, req: _Request):
         rep.begin_call()
@@ -878,8 +1107,11 @@ class Router:
             return
         self._closed = True
         self._probe_stop.set()
+        self._registry_stop.set()
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=5)
+        if self._registry_thread is not None:
+            self._registry_thread.join(timeout=5)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -26,12 +26,17 @@ from .batcher import (BucketedPredictor, DeadlineExceededError, MicroBatcher,
                       QueueFullError, ServerClosedError, pow2_buckets)
 from .metrics import ServingMetrics
 
-__all__ = ["InferenceServer"]
+__all__ = ["InferenceServer", "install_preemption_handler"]
 
 register_env("MXNET_SERVING_MAX_WAIT_US", 2000, int,
              "Default micro-batch flush deadline for InferenceServer.")
 register_env("MXNET_SERVING_MAX_QUEUE", 256, int,
              "Default admission-control queue bound for InferenceServer.")
+register_env("MXNET_SERVING_DRAIN_TIMEOUT_MS", 30000.0, float,
+             "Hard deadline for a draining InferenceServer stop: past it, "
+             "still-pending requests are force-cancelled with "
+             "DrainTimeoutError instead of letting a wedged batch worker "
+             "hang retirement forever.")
 
 
 class InferenceServer:
@@ -176,12 +181,24 @@ class InferenceServer:
         self._warmed = True
         return self
 
-    def stop(self, drain: bool = True):
+    def begin_drain(self):
+        """Flip to draining WITHOUT stopping: ``ready()`` goes False (so
+        ``/readyz`` answers 503 and a router stops dispatching here) while
+        in-flight and queued work keeps completing.  The scale-in /
+        preemption first step — quiesce arrivals, then :meth:`stop`."""
+        self._draining = True
+        return self
+
+    def stop(self, drain: bool = True, timeout_ms: Optional[float] = None):
         """Stop the service.  With ``drain`` (default) queued requests are
-        flushed before the workers exit; without it they fail fast with
-        :class:`ServerClosedError`.  Idempotent: a second ``stop`` (any
-        ``drain`` value) is a no-op rather than re-failing futures or
-        re-joining dead workers."""
+        flushed before the workers exit — bounded by ``timeout_ms``
+        (default ``MXNET_SERVING_DRAIN_TIMEOUT_MS``): past the deadline
+        remaining futures are force-cancelled with
+        :class:`~mxnet_tpu.serving.batcher.DrainTimeoutError` so a wedged
+        worker can never hang retirement.  Without ``drain`` they fail
+        fast with :class:`ServerClosedError`.  Idempotent: a second
+        ``stop`` (any ``drain`` value) is a no-op rather than re-failing
+        futures or re-joining dead workers."""
         if self._stopped:
             return
         self._stopped = True
@@ -193,7 +210,10 @@ class InferenceServer:
             if self._http_thread is not None:
                 self._http_thread.join(timeout=5)
                 self._http_thread = None
-        self._batcher.stop(drain=drain)
+        if timeout_ms is None:
+            timeout_ms = env("MXNET_SERVING_DRAIN_TIMEOUT_MS", 30000.0,
+                             float)
+        self._batcher.stop(drain=drain, timeout=timeout_ms / 1e3)
 
     def __enter__(self):
         return self.start()
@@ -432,3 +452,62 @@ class InferenceServer:
             daemon=True)
         self._http_thread.start()
         return self._httpd.server_address
+
+
+def install_preemption_handler(server, deregister=None, sig=None,
+                               drain_timeout_ms=None, exit_process=True):
+    """Install the serving preemption path on ``sig`` (default SIGTERM),
+    mirroring the training workers' handler (kvstore.py): flip the
+    replica to draining (``/readyz`` 503 so routers stop dispatching),
+    run ``deregister`` if given (drop out of the replica registry so
+    replicated routers converge before the process dies), drain bounded
+    by ``MXNET_SERVING_DRAIN_TIMEOUT_MS``, dump a flight-recorder
+    postmortem, and exit 0 — autoscaler retirement and cluster
+    preemption share this one path, and a clean preemption must not
+    look like a crash to the launcher.  Returns the handler (tests
+    invoke it directly); the signal itself is only hooked from the main
+    thread (``signal.signal`` constraint — elsewhere the handler comes
+    back uninstalled)."""
+    import logging
+    import os
+    import signal as _signal
+
+    if sig is None:
+        sig = _signal.SIGTERM
+    fired = threading.Event()
+
+    def handler(signum=None, frame=None):
+        if fired.is_set():
+            return
+        fired.set()
+        logging.info("serving preemption signal: draining, deregistering")
+        try:
+            server.begin_drain()
+        except Exception as e:
+            logging.warning("preemption begin_drain failed: %s", e)
+        if deregister is not None:
+            try:
+                deregister()
+            except Exception as e:
+                logging.warning("preemption deregister failed: %s", e)
+        try:
+            server.stop(drain=True, timeout_ms=drain_timeout_ms)
+        except Exception as e:
+            logging.warning("preemption drain/stop failed: %s", e)
+        try:
+            # flight recorder: the postmortem is the only record of this
+            # replica's final state once we _exit (no atexit hooks run)
+            from .. import telemetry as _tm
+
+            _tm.flight_recorder.dump("preemption-sigterm-serving")
+        except Exception:
+            pass
+        if exit_process:
+            os._exit(0)
+
+    if threading.current_thread() is threading.main_thread():
+        try:
+            _signal.signal(sig, handler)
+        except (ValueError, OSError):
+            pass
+    return handler

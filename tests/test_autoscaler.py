@@ -1,0 +1,284 @@
+"""Autoscaler + replica-registry tests.
+
+The control-loop state machine (hysteresis, cooldown, band clamping,
+signal classification) runs on a fake clock against a fake router — no
+real sleeps, every decision deterministic.  The registry tests cover
+the membership contract (generations, heartbeats, stale eviction) and
+its HTTP face; the chaos-marked acceptance test replays the
+``flash-crowd`` scenario from tools/chaos_run.py end to end.
+"""
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+IN_DIM = 6
+HID = 3
+
+
+# -- fakes: the state machine needs signals, not servers ---------------------
+class FakeRouter:
+    def __init__(self):
+        self.sig = dict(pressure=0.0, replicas=1, ready=1, draining=0,
+                        breakers_open=0, shed_total=0, expired_total=0,
+                        p99_ms={}, deadline_ms={})
+        self.added = []
+        self.removed = []
+
+    def signals(self):
+        return dict(self.sig)
+
+    def add_replica(self, backend, name=None):
+        self.added.append(name)
+        self.sig["replicas"] += 1
+        return name
+
+    def remove_replica(self, name, drain=True, drain_timeout_ms=None,
+                       wait=True):
+        self.removed.append(name)
+        self.sig["replicas"] -= 1
+        return "backend"
+
+    def describe(self):
+        return [{"name": n, "draining": False, "inflight": 0,
+                 "queue_depth": 0}
+                for n in self.added if n not in self.removed]
+
+
+class FakeProvider:
+    self_registering = False
+
+    def __init__(self):
+        self.n = 0
+        self.retired = []
+
+    def spawn(self):
+        self.n += 1
+        return "a%d" % self.n, object()
+
+    def retire(self, name, backend):
+        self.retired.append(name)
+
+
+def _scaler(router, provider, clock, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("hysteresis", 2)
+    kw.setdefault("cooldown_ms", 1000)
+    return serving.Autoscaler(router, provider, clock=clock, **kw)
+
+
+def test_autoscaler_hysteresis_on_fake_clock():
+    """One hot tick must not spawn; K consecutive ticks must.  A cold
+    tick in between resets the streak."""
+    t = [0.0]
+    r, p = FakeRouter(), FakeProvider()
+    asc = _scaler(r, p, lambda: t[0], hysteresis=3)
+    r.sig["pressure"] = 0.9
+    assert asc.tick() is None
+    assert asc.tick() is None
+    r.sig["pressure"] = 0.2          # back to normal: streak resets
+    assert asc.tick() is None
+    r.sig["pressure"] = 0.9
+    assert asc.tick() is None
+    assert asc.tick() is None
+    ev = asc.tick()                  # third consecutive hot tick
+    assert ev["op"] == "scale_out" and ev["ok"]
+    assert r.added == ["a1"]
+    assert "pressure" in ev["why"]
+
+
+def test_autoscaler_cooldown_on_fake_clock():
+    """After an actuation no decision fires inside the cooldown window,
+    however hot the signals; the first tick past the window may."""
+    t = [0.0]
+    r, p = FakeRouter(), FakeProvider()
+    asc = _scaler(r, p, lambda: t[0], cooldown_ms=1000)
+    r.sig["pressure"] = 1.0
+    asc.tick()
+    assert asc.tick()["op"] == "scale_out"
+    for _ in range(20):              # still t=0: deep in cooldown
+        assert asc.tick() is None
+    t[0] = 0.999
+    assert asc.tick() is None
+    t[0] = 1.001                     # window over; streak long satisfied
+    assert asc.tick()["op"] == "scale_out"
+    assert len(r.added) == 2
+
+
+def test_autoscaler_band_and_ownership():
+    """Never spawns above MAX; never drains below MIN; never retires a
+    replica it did not spawn (the seed fleet is the operator's)."""
+    t = [0.0]
+    r, p = FakeRouter(), FakeProvider()
+    asc = _scaler(r, p, lambda: t[0], max_replicas=2, cooldown_ms=100)
+    r.sig["pressure"] = 1.0
+    asc.tick()
+    assert asc.tick()["op"] == "scale_out"
+    t[0] = 1.0
+    for _ in range(5):
+        assert asc.tick() is None    # at MAX: hot ticks do nothing
+    assert r.sig["replicas"] == 2
+    r.sig["pressure"] = 0.0
+    t[0] = 2.0
+    asc.tick()
+    ev = asc.tick()
+    assert ev["op"] == "scale_in" and ev["replica"] == "a1"
+    assert p.retired == ["a1"]
+    t[0] = 3.0
+    for _ in range(5):
+        assert asc.tick() is None    # at MIN, and the seed is not ours
+    assert r.sig["replicas"] == 1 and r.removed == ["a1"]
+
+
+def test_autoscaler_slo_breaker_and_shed_votes():
+    """Every documented overload signal votes scale-out: p99 over the
+    deadline budget, an open breaker, and a positive shed delta."""
+    def keep_shedding(sig):
+        sig["shed_total"] += 5       # sheds keep landing every tick
+
+    for hot in (lambda sig: sig.update(p99_ms={"interactive": 90.0},
+                                       deadline_ms={"interactive": 50.0}),
+                lambda sig: sig.update(breakers_open=1),
+                keep_shedding):
+        t = [0.0]
+        r, p = FakeRouter(), FakeProvider()
+        asc = _scaler(r, p, lambda: t[0])
+        asc.tick()                   # baseline tick (shed delta needs one)
+        hot(r.sig)
+        asc.tick()
+        hot(r.sig)
+        ev = asc.tick()
+        assert ev is not None and ev["op"] == "scale_out", hot
+    # p99 UNDER budget is not a vote
+    t = [0.0]
+    r, p = FakeRouter(), FakeProvider()
+    asc = _scaler(r, p, lambda: t[0])
+    r.sig.update(p99_ms={"interactive": 30.0},
+                 deadline_ms={"interactive": 50.0}, pressure=0.2)
+    for _ in range(5):
+        assert asc.tick() is None
+
+
+def test_autoscaler_decisions_are_fault_injectable():
+    """An injected fault on the dotted scale-out op surfaces as a failed
+    (but logged) decision; the loop survives and succeeds once clear."""
+    t = [0.0]
+    r, p = FakeRouter(), FakeProvider()
+    asc = _scaler(r, p, lambda: t[0], cooldown_ms=100)
+    r.sig["pressure"] = 1.0
+    with mx.faults.inject("serving.autoscaler.scale_out:ioerr=1", seed=0):
+        asc.tick()
+        ev = asc.tick()
+        assert ev["op"] == "scale_out" and not ev["ok"]
+        assert "error" in ev
+    assert r.added == []             # the actuation never happened
+    t[0] = 1.0
+    asc.tick()
+    assert asc.tick()["ok"]          # fault cleared: next attempt lands
+
+
+# -- the registry: membership contract ---------------------------------------
+def test_registry_generations_and_heartbeat():
+    reg = serving.ReplicaRegistry(ttl_ms=60000)
+    g0 = reg.gen()
+    g1 = reg.register("a", "127.0.0.1:1", {"v": 1})
+    assert g1 == g0 + 1
+    assert reg.register("a", "127.0.0.1:1") == g1   # refresh: no gen bump
+    assert reg.heartbeat("a") and not reg.heartbeat("ghost")
+    live = reg.live()
+    assert live["gen"] == g1 and live["replicas"] == {"a": "127.0.0.1:1"}
+    g2 = reg.deregister("a")
+    assert g2 == g1 + 1 and reg.live()["replicas"] == {}
+    assert reg.deregister("a") == g2                # idempotent
+
+
+def test_registry_stale_eviction():
+    reg = serving.ReplicaRegistry(ttl_ms=80)
+    reg.register("fast", "x")
+    reg.register("dead", "y")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        reg.heartbeat("fast")
+        if set(reg.live()["replicas"]) == {"fast"}:
+            break
+        time.sleep(0.02)
+    assert set(reg.live()["replicas"]) == {"fast"}
+
+
+def test_registry_http_face_roundtrip():
+    reg = serving.ReplicaRegistry(ttl_ms=60000)
+    try:
+        reg.serve_http()
+        cli = serving.RegistryClient(reg.addr)
+        g = cli.register("web", "127.0.0.1:9")
+        assert cli.live()["replicas"] == {"web": "127.0.0.1:9"}
+        assert cli.gen() == g
+        assert cli.heartbeat("web") and not cli.heartbeat("ghost")
+        cli.deregister("web")
+        assert cli.live()["replicas"] == {}
+        with pytest.raises(Exception):  # object backends cannot cross HTTP
+            cli.register("bad", {"not": "a string"})
+    finally:
+        reg.close()
+
+
+def test_start_heartbeater_reregisters_after_eviction():
+    reg = serving.ReplicaRegistry(ttl_ms=150)
+    stop = serving.start_heartbeater(reg, "r0", "b", interval_ms=30)
+    try:
+        time.sleep(0.4)              # several TTLs: beats must hold it live
+        assert "r0" in reg.live()["replicas"]
+    finally:
+        stop()
+    assert "r0" not in reg.live()["replicas"]   # stop() deregistered
+
+
+# -- serving preemption handler (shared retirement path) ---------------------
+def _tiny_server(**kw):
+    rng = np.random.RandomState(0)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=HID,
+                                name="fc")
+    params = {"fc_weight": mx.nd.array(
+                  rng.randn(HID, IN_DIM).astype(np.float32)),
+              "fc_bias": mx.nd.array(rng.randn(HID).astype(np.float32))}
+    kw.setdefault("max_wait_us", 1000)
+    kw.setdefault("warmup", False)
+    return serving.InferenceServer(net, params, {"data": (4, IN_DIM)}, **kw)
+
+
+def test_serving_preemption_handler_drains_and_deregisters():
+    """SIGTERM path: drain (readyz flips 503 first), deregister, stop —
+    idempotent on repeated signals, and no process exit in test mode."""
+    srv = _tiny_server()
+    calls = []
+    handler = serving.install_preemption_handler(
+        srv, deregister=lambda: calls.append("dereg"), exit_process=False)
+    fut = srv.submit(data=np.zeros(IN_DIM, np.float32))
+    handler(signal.SIGTERM, None)
+    assert calls == ["dereg"]
+    assert srv.ready_state() == "stopped"
+    assert fut.result(timeout=10) is not None   # drained, not dropped
+    handler(signal.SIGTERM, None)               # idempotent
+    assert calls == ["dereg"]
+
+
+@pytest.mark.chaos
+def test_flash_crowd_end_to_end():
+    """Acceptance: diurnal + flash-crowd load over a replicated front
+    door; the fleet scales 1→N→1, one router dies mid-flood, zero failed
+    requests, zero interactive-SLO violations, and every scaled-out
+    replica's first request runs with cold_bucket_runs()==0."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    from chaos_run import run_flash_crowd
+
+    assert run_flash_crowd(seed=3, timeout=90.0)

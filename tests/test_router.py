@@ -399,3 +399,150 @@ def test_router_dispatch_emits_profiler_frames(tmp_path):
     names = {e["name"] for e in events}
     assert any(n.startswith("router/dispatch") for n in names)
     assert any(n.startswith("router/call") for n in names)
+
+
+def test_breaker_half_open_admits_exactly_one_concurrent_probe():
+    """The check-then-act race: N dispatcher threads all see a half-open
+    idle breaker at once — try_reserve must hand the probe slot to
+    exactly one of them, and release/end_call must hand it back."""
+    _, _, srvs = _servers(1)
+    router = serving.Router(srvs)
+    try:
+        rep = router.replicas()[0]
+        rep.state = serving.router.BREAKER_HALF_OPEN
+        rep._probe_inflight = False
+        wins = []
+        barrier = threading.Barrier(8)
+
+        def contender():
+            barrier.wait()
+            if rep.try_reserve():
+                wins.append(1)
+
+        threads = [threading.Thread(target=contender) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1
+        assert not rep.try_reserve()        # slot is held
+        rep.release()                       # reservation never became a call
+        assert rep.try_reserve()            # slot handed back
+        rep.end_call(True, 1.0)            # probe success: breaker closes
+        assert rep.state == serving.router.BREAKER_CLOSED
+        assert rep.try_reserve()            # closed admits everything
+    finally:
+        router.close(stop_backends=True)
+
+
+def test_remote_probe_debounce(monkeypatch):
+    """One slow /healthz under load must not flap the replica: cached
+    health flips down only after K consecutive probe failures, and one
+    success flips it straight back up."""
+    monkeypatch.setenv("MXNET_SERVING_PROBE_FAILURES", "3")
+    _, _, srvs = _servers(1)
+    srv = srvs[0]
+    host, port = srv.serve_http()
+    router = serving.Router(["%s:%d" % (host, port)], seed=5)
+    try:
+        rep = router.replicas()[0]
+        rep._probe()
+        assert rep.ready() and rep.alive()
+        # sever the backend: probes now fail, but the cache holds
+        good_base = rep._base
+        rep._base = "http://127.0.0.1:1"    # nothing listens here
+        rep._probe()
+        assert rep.ready() and rep.alive()  # miss 1: debounced
+        rep._probe()
+        assert rep.ready() and rep.alive()  # miss 2: debounced
+        rep._probe()
+        assert not rep.ready() and not rep.alive()  # miss 3 == K: down
+        rep._base = good_base
+        rep._probe()
+        assert rep.ready() and rep.alive()  # one success: up immediately
+    finally:
+        router.close()
+        srv.stop()
+
+
+def test_remote_probe_first_contact_is_not_debounced():
+    """A backend that was never up must not be routed to for K probe
+    periods — the first-contact miss counts immediately."""
+    _, _, srvs = _servers(1)
+    router = serving.Router(srvs)   # anchor replica so dispatch still works
+    try:
+        dead = serving.router._RemoteReplica(
+            "dead", "127.0.0.1:1", router)
+        dead._probe()
+        assert not dead.ready() and not dead.alive()
+    finally:
+        router.close(stop_backends=True)
+
+
+def test_router_dynamic_add_remove_replica():
+    """The autoscaler's actuation surface: add_replica puts a backend in
+    rotation (traffic reaches it), remove_replica drains it out and
+    returns the backend; duplicate names are rejected."""
+    net, params, srvs = _servers(1)
+    router = serving.Router(srvs, seed=6)
+    try:
+        extra = serving.InferenceServer(
+            net, dict(params), {"data": (4, IN_DIM)},
+            max_wait_us=1000, warmup=False)
+        name = router.add_replica(extra)
+        assert len(router.replicas()) == 2
+        with pytest.raises(mx.MXNetError):
+            router.add_replica(extra, name=name)
+        X = np.random.RandomState(2).randn(12, IN_DIM).astype(np.float32)
+        for i in range(12):
+            router.predict(data=X[i])
+        calls = {d["name"]: d["calls"] for d in router.describe()}
+        assert calls[name] > 0          # the new replica took traffic
+        back = router.remove_replica(name, drain_timeout_ms=5000)
+        assert back is extra
+        assert len(router.replicas()) == 1
+        assert router.remove_replica("ghost") is None
+        router.predict(data=X[0])       # the survivor still serves
+        extra.stop()
+    finally:
+        router.close(stop_backends=True)
+
+
+def test_router_registry_sync_converges():
+    """Replicated front door: two routers attached to one registry
+    converge on the same live set — a registered member appears in both,
+    a deregistered member drains out of both."""
+    net, params, srvs = _servers(2)
+    registry = serving.ReplicaRegistry(ttl_ms=60000)
+    registry.register("a", srvs[0])
+    routers = [serving.Router(registry=registry, registry_sync_ms=30,
+                              seed=i) for i in range(2)]
+    try:
+        assert all(len(r.replicas()) == 1 for r in routers)
+        registry.register("b", srvs[1])
+
+        def names(r):
+            return {d["name"] for d in r.describe()}
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if all(names(r) == {"a", "b"} for r in routers):
+                break
+            time.sleep(0.02)
+        assert all(names(r) == {"a", "b"} for r in routers)
+        for r in routers:
+            r.predict(data=np.zeros(IN_DIM, np.float32))
+        registry.deregister("b")
+        while time.monotonic() < deadline:
+            if all(names(r) == {"a"} for r in routers):
+                break
+            time.sleep(0.02)
+        assert all(names(r) == {"a"} for r in routers)
+        for r in routers:                  # both front doors still serve
+            r.predict(data=np.zeros(IN_DIM, np.float32))
+    finally:
+        for r in routers:
+            r.close()
+        for s in srvs:
+            s.stop()
+        registry.close()

@@ -452,3 +452,65 @@ def test_healthz_degraded_when_worker_thread_dies():
         assert "mxtpu_serving_worker_crashes 1" in text
     finally:
         srv.stop()
+
+
+def test_drain_deadline_force_cancels_wedged_worker():
+    """A wedged batch worker must not hang retirement: stop(drain=True)
+    past MXNET_SERVING_DRAIN_TIMEOUT_MS force-cancels every remaining
+    future with DrainTimeoutError instead of waiting forever."""
+    net, params = _tiny_model()
+    srv = serving.InferenceServer(net, params, {"data": (4, IN_DIM)},
+                                  max_wait_us=1000, warmup=False)
+    wedge = threading.Event()
+    real_forward = srv._replicas[0].forward_batch
+
+    def wedged_forward(items):
+        wedge.wait()                    # the worker is stuck mid-batch
+        return real_forward(items)
+
+    srv._replicas[0].forward_batch = wedged_forward
+    futs = [srv.submit(data=np.zeros(IN_DIM, np.float32))
+            for _ in range(6)]
+    t0 = time.monotonic()
+    srv.stop(drain=True, timeout_ms=300)
+    assert time.monotonic() - t0 < 10.0     # bounded, not forever
+    cancelled = 0
+    for f in futs:
+        assert f.done()
+        try:
+            f.result(timeout=0)
+        except serving.DrainTimeoutError:
+            cancelled += 1
+    assert cancelled == len(futs)
+    wedge.set()                             # unwedge; late completion is
+    time.sleep(0.1)                         # dropped, never raised
+
+
+def test_drain_completes_before_deadline_without_cancel():
+    """The hard deadline is a backstop: a healthy drain still flushes
+    every queued request successfully."""
+    net, params = _tiny_model()
+    srv = serving.InferenceServer(net, params, {"data": (4, IN_DIM)},
+                                  max_wait_us=1000, warmup=False)
+    futs = [srv.submit(data=np.zeros(IN_DIM, np.float32))
+            for _ in range(6)]
+    srv.stop(drain=True, timeout_ms=30000)
+    for f in futs:
+        assert f.result(timeout=0) is not None
+
+
+def test_begin_drain_flips_readiness_only():
+    """begin_drain quiesces arrivals (readyz 503) while the server keeps
+    completing work — the scale-in first step."""
+    net, params = _tiny_model()
+    srv = serving.InferenceServer(net, params, {"data": (4, IN_DIM)},
+                                  max_wait_us=1000, warmup=False)
+    try:
+        fut = srv.submit(data=np.zeros(IN_DIM, np.float32))
+        srv.begin_drain()
+        assert srv.ready_state() == "draining" and not srv.ready()
+        assert fut.result(timeout=30) is not None   # in-flight completes
+        status, _ = srv.health()
+        assert status == "ok"                       # liveness untouched
+    finally:
+        srv.stop()

@@ -189,6 +189,154 @@ def run_router_point(router, victim, offered_qps, duration, in_dim,
     return row
 
 
+def run_autoscale_phase(cli):
+    """Drive a diurnal load curve (the --load points, in order, each for
+    --duration seconds) through a registry-backed Router while the
+    Autoscaler grows/shrinks the fleet, and emit ONE BENCH record:
+    offered curve, scale events, per-class p50/p99, SLO violations, and
+    the warm-start cold_bucket_runs of every spawned replica."""
+    import tempfile
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    rng = np.random.RandomState(0)
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=cli.hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=cli.hidden, name="fc2")
+    params = {
+        "fc1_weight": mx.nd.array(
+            rng.randn(cli.hidden, cli.in_dim).astype(np.float32) * 0.05),
+        "fc1_bias": mx.nd.array(np.zeros(cli.hidden, np.float32)),
+        "fc2_weight": mx.nd.array(
+            rng.randn(cli.hidden, cli.hidden).astype(np.float32) * 0.05),
+        "fc2_bias": mx.nd.array(np.zeros(cli.hidden, np.float32)),
+    }
+    tmp = tempfile.mkdtemp(prefix="bench-autoscale-")
+    prefix = os.path.join(tmp, "m")
+    mx.model.save_checkpoint(prefix, 1, net, params, {})
+    shapes = {"data": (cli.max_batch, cli.in_dim)}
+    server_kw = dict(max_wait_us=cli.max_wait_us, max_queue=cli.max_queue)
+    cache_prev = os.environ.get("MXNET_COMPILE_CACHE_DIR")
+    os.environ["MXNET_COMPILE_CACHE_DIR"] = os.path.join(tmp, "cache")
+
+    spawned = []
+
+    class Provider(serving.LocalCheckpointProvider):
+        def spawn(self):
+            t0 = time.monotonic()
+            name, server = super().spawn()
+            spawned.append((name, server,
+                            (time.monotonic() - t0) * 1e3))
+            return name, server
+
+    registry = serving.ReplicaRegistry(ttl_ms=3000)
+    seed_srv = serving.InferenceServer.from_checkpoint(
+        prefix, 1, shapes, attach_aot=False, **server_kw)
+    seed_srv.save_aot_bundle(prefix, 1)
+    stop_beat = serving.start_heartbeater(registry, "seed0", seed_srv,
+                                          interval_ms=500)
+    router = serving.Router(registry=registry, registry_sync_ms=100, seed=0)
+    provider = Provider(prefix, 1, shapes, registry=registry,
+                        attach_aot=True, **server_kw)
+    scaler = serving.Autoscaler(
+        router, provider, min_replicas=1, max_replicas=cli.autoscale,
+        interval_ms=100, hysteresis=2, cooldown_ms=500,
+        drain_timeout_ms=10000)
+    scaler.start()
+
+    x = np.zeros(cli.in_dim, np.float32)
+    lock = threading.Lock()
+    counts = {"submitted": 0, "shed": 0, "failed": 0, "expired": 0}
+    futures = []
+    loads = [float(s) for s in cli.load.split(",") if s]
+    curve = []
+    peak = [1]
+    try:
+        for qps in loads:
+            stop_at = time.monotonic() + cli.duration
+            per_thread = qps / 8
+
+            def submitter(seed):
+                prng = random.Random(seed)
+                while time.monotonic() < stop_at:
+                    time.sleep(prng.expovariate(per_thread))
+                    slo = ("batch" if prng.random() < cli.batch_frac
+                           else "interactive")
+                    try:
+                        fut = router.submit(slo=slo, data=x)
+                        with lock:
+                            counts["submitted"] += 1
+                            futures.append(fut)
+                    except serving.RouterOverloadError:
+                        with lock:
+                            counts["shed"] += 1
+
+            t0 = time.monotonic()
+            threads = [threading.Thread(target=submitter, args=(i,),
+                                        daemon=True) for i in range(8)]
+            for t in threads:
+                t.start()
+            while any(t.is_alive() for t in threads):
+                sig = router.signals()
+                peak[0] = max(peak[0], sig["replicas"] - sig["draining"])
+                time.sleep(0.1)
+            sig = router.signals()
+            curve.append({"offered_qps": qps,
+                          "replicas_at_end": sig["replicas"]
+                          - sig["draining"],
+                          "pressure_at_end": round(sig["pressure"], 3),
+                          "elapsed_s": round(time.monotonic() - t0, 2)})
+        for fut in futures:
+            try:
+                fut.result(timeout=60)
+            except serving.DeadlineExceededError:
+                counts["expired"] += 1
+            except Exception:
+                counts["failed"] += 1
+    finally:
+        scaler.stop(retire_owned=True)
+        router.close()
+        stop_beat()
+        seed_srv.stop(drain=True)
+        registry.close()
+        if cache_prev is None:
+            os.environ.pop("MXNET_COMPILE_CACHE_DIR", None)
+        else:
+            os.environ["MXNET_COMPILE_CACHE_DIR"] = cache_prev
+
+    snap = router.metrics.snapshot()
+    row = {
+        "metric": "serving_autoscale",
+        "mode": "autoscale",
+        "value": counts["submitted"],
+        "unit": "requests",
+        "load_curve": curve,
+        "submitted": counts["submitted"],
+        "failed": counts["failed"],
+        "shed": counts["shed"],
+        "expired": counts["expired"],
+        "slo_violations_interactive": snap["expired"].get("interactive", 0)
+        + snap["shed"].get("interactive", 0),
+        "peak_replicas": peak[0],
+        "scale_events": [{k: e[k] for k in ("op", "ok", "why")
+                          if k in e} for e in scaler.events],
+        "spawns": [{"replica": n, "spawn_ms": round(ms, 1),
+                    "cold_bucket_runs": s.cold_bucket_runs()}
+                   for n, s, ms in spawned],
+    }
+    for slo in ("interactive", "batch"):
+        for q, key in ((.50, "p50"), (.99, "p99")):
+            v = router.metrics.latency_quantile(q, slo)
+            if v is not None:
+                row["latency_ms_%s_%s" % (key, slo)] = v
+    import shutil
+    shutil.rmtree(tmp, ignore_errors=True)
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--load", default="50,200,800",
@@ -206,9 +354,23 @@ def main():
     ap.add_argument("--batch-frac", type=float, default=0.2,
                     help="fraction of router traffic in the sheddable "
                          "'batch' SLO class")
+    ap.add_argument("--autoscale", type=int, default=0, metavar="MAX",
+                    help="drive the --load points as one diurnal curve "
+                         "through a registry-backed Router while the "
+                         "Autoscaler scales 1..MAX replicas; emits one "
+                         "BENCH record with the scale-event trace")
     ap.add_argument("--out", default=None,
                     help="also append JSON lines to this file")
     cli = ap.parse_args()
+
+    if cli.autoscale:
+        row = run_autoscale_phase(cli)
+        line = json.dumps(row)
+        print(line, flush=True)
+        if cli.out:
+            with open(cli.out, "a") as sink:
+                sink.write(line + "\n")
+        return
 
     loads = [float(s) for s in cli.load.split(",") if s]
     sink = open(cli.out, "a") if cli.out else None

@@ -351,8 +351,243 @@ def run_serving_failover(seed, timeout=120.0, replicas=3, load_threads=4):
     return ok
 
 
+def run_flash_crowd(seed, timeout=120.0, max_replicas=3, load_threads=6):
+    """Self-healing fleet probe, in-process: a replicated front door
+    (two Routers over one ReplicaRegistry) serves diurnal + flash-crowd
+    open-loop load over a fleet the Autoscaler grows 1→N and shrinks
+    back to 1, spawning every replica warm (AOT bundle + compile cache
+    attached), while one router is killed mid-flood and its clients
+    fail over to the survivor.  Passes when the fleet scaled out (>= 2
+    replicas at peak) and back in (1 at the end), zero client requests
+    failed end to end, zero interactive-SLO violations (no sheds, no
+    deadline expiries), and every scaled-out replica served its first
+    request with ``cold_bucket_runs() == 0``."""
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    in_dim, hid = 6, 3
+    rng = np.random.RandomState(seed)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=hid,
+                                name="fc")
+    params = {"fc_weight": mx.nd.array(
+                  rng.randn(hid, in_dim).astype(np.float32)),
+              "fc_bias": mx.nd.array(rng.randn(hid).astype(np.float32))}
+
+    tmp = tempfile.mkdtemp(prefix="chaos-flashcrowd-")
+    prefix = os.path.join(tmp, "m")
+    mx.model.save_checkpoint(prefix, 1, net, params, {})
+    shapes = {"data": (4, in_dim)}
+    server_kw = dict(max_wait_us=1000, max_queue=8)
+    cache_key, cache_prev = "MXNET_COMPILE_CACHE_DIR", \
+        os.environ.get("MXNET_COMPILE_CACHE_DIR")
+    os.environ[cache_key] = os.path.join(tmp, "cache")
+
+    class TrackingProvider(serving.LocalCheckpointProvider):
+        """LocalCheckpointProvider remembering every spawn, so the
+        cold-start acceptance check covers retired replicas too."""
+
+        spawned = []
+
+        def spawn(self):
+            name, server = super().spawn()
+            self.spawned.append((name, server))
+            return name, server
+
+    registry = serving.ReplicaRegistry(ttl_ms=2000)
+    # the seed replica primes the compile cache and ships its AOT
+    # bundle, so every scale-out spawn warms deserialize-only
+    seed_srv = serving.InferenceServer.from_checkpoint(
+        prefix, 1, shapes, attach_aot=False, **server_kw)
+    seed_srv.save_aot_bundle(prefix, 1)
+    stop_seed_beat = serving.start_heartbeater(registry, "seed0", seed_srv,
+                                               interval_ms=200)
+    slos = {"interactive": serving.SLOClass("interactive", deadline_ms=5000,
+                                            priority=0, sheddable=False),
+            "batch": serving.SLOClass("batch", priority=1, sheddable=True)}
+    routers = [serving.Router(registry=registry, registry_sync_ms=50,
+                              slo_classes=dict(slos), seed=seed + i,
+                              retries=3)
+               for i in range(2)]
+    provider = TrackingProvider(prefix, 1, shapes, registry=registry,
+                                attach_aot=True, **server_kw)
+    autoscaler = serving.Autoscaler(
+        routers[0], provider, min_replicas=1, max_replicas=max_replicas,
+        interval_ms=50, out_pressure=0.3, in_pressure=0.05, hysteresis=2,
+        cooldown_ms=300, drain_timeout_ms=10000)
+    autoscaler.start()
+
+    X = rng.randn(8, in_dim).astype(np.float32)
+    alive = [True, True]  # routers[1] is killed mid-flood
+    phase = ["low"]
+    stop_evt = threading.Event()
+    failures = []
+    served = [0]
+    peak = [1]
+
+    def one_request(tid, i):
+        """End-to-end client call: bounded retry over the replicated
+        front door (a killed router or a 429/overload answer means
+        back off and go to the other one — the documented contract)."""
+        deadline = time.monotonic() + 10.0
+        last = None
+        while time.monotonic() < deadline:
+            for k in range(2):
+                r = (tid + i + k) % 2
+                if not alive[r]:
+                    continue
+                try:
+                    routers[r].predict(slo="interactive", deadline_ms=5000,
+                                       data=X[i % len(X)])
+                    served[0] += 1
+                    return True
+                except Exception as exc:
+                    last = exc
+            time.sleep(0.01)
+        failures.append(repr(last))
+        return False
+
+    def load(tid):
+        i = 0
+        while not stop_evt.is_set():
+            if phase[0] == "low":
+                one_request(tid, i)
+                i += 1
+                time.sleep(0.05)
+            else:  # flood: open-loop burst through the front door
+                futs = []
+                for _ in range(4):
+                    r = 0 if not alive[1] else (tid + i) % 2
+                    try:
+                        futs.append(routers[r].submit(
+                            slo="interactive", deadline_ms=5000,
+                            data=X[i % len(X)]))
+                    except Exception:
+                        one_request(tid, i)
+                    i += 1
+                for f in futs:
+                    try:
+                        f.result()
+                        served[0] += 1
+                    except Exception:
+                        one_request(tid, i)
+
+    def active_replicas():
+        sig = routers[0].signals()
+        return sig["replicas"] - sig["draining"]
+
+    deadline = time.monotonic() + timeout
+    ok = True
+    threads = [threading.Thread(target=load, args=(t,), daemon=True)
+               for t in range(load_threads)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.8)  # diurnal trough: fleet must hold at 1
+        print("chaos_run: flash crowd begins (replicas=%d)"
+              % active_replicas(), file=sys.stderr, flush=True)
+        phase[0] = "flood"
+        while time.monotonic() < deadline:
+            peak[0] = max(peak[0], active_replicas())
+            if peak[0] >= 2:
+                break
+            time.sleep(0.05)
+        if peak[0] < 2:
+            print("chaos_run: fleet never scaled out under the flood",
+                  file=sys.stderr, flush=True)
+            ok = False
+        # kill one front door mid-flood: clients must fail over
+        alive[1] = False
+        routers[1].close()
+        print("chaos_run: router 1 killed mid-flood (replicas=%d)"
+              % active_replicas(), file=sys.stderr, flush=True)
+        t_flood_end = time.monotonic() + 1.0
+        while time.monotonic() < min(t_flood_end, deadline):
+            peak[0] = max(peak[0], active_replicas())
+            time.sleep(0.05)
+        phase[0] = "low"
+        print("chaos_run: flash crowd over (peak replicas=%d); cooling"
+              % peak[0], file=sys.stderr, flush=True)
+        while time.monotonic() < deadline:
+            if active_replicas() <= 1 and not autoscaler.owned():
+                break
+            time.sleep(0.1)
+        else:
+            print("chaos_run: fleet never scaled back in",
+                  file=sys.stderr, flush=True)
+            ok = False
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=max(1.0, deadline - time.monotonic()))
+    finally:
+        stop_evt.set()
+        autoscaler.stop(retire_owned=True)
+        for r, rt in enumerate(routers):
+            if alive[r]:
+                rt.close()
+        stop_seed_beat()
+        seed_srv.stop(drain=True)
+        registry.close()
+        if cache_prev is None:
+            os.environ.pop(cache_key, None)
+        else:
+            os.environ[cache_key] = cache_prev
+
+    if failures:
+        print("chaos_run: %d client requests failed end to end (first: %s)"
+              % (len(failures), failures[:3]), file=sys.stderr, flush=True)
+        ok = False
+    snap = routers[0].metrics.snapshot()
+    violations = snap["expired"].get("interactive", 0) + \
+        snap["shed"].get("interactive", 0)
+    if violations:
+        print("chaos_run: %d interactive-SLO violations" % violations,
+              file=sys.stderr, flush=True)
+        ok = False
+    scale_outs = [e for e in autoscaler.events
+                  if e["op"] == "scale_out" and e["ok"]]
+    scale_ins = [e for e in autoscaler.events
+                 if e["op"] == "scale_in" and e["ok"]]
+    if not scale_outs or not scale_ins:
+        print("chaos_run: missing scale events (out=%d in=%d)"
+              % (len(scale_outs), len(scale_ins)),
+              file=sys.stderr, flush=True)
+        ok = False
+    cold = {n: s.cold_bucket_runs() for n, s in TrackingProvider.spawned}
+    if any(cold.values()):
+        print("chaos_run: scaled-out replicas served cold buckets: %s"
+              % cold, file=sys.stderr, flush=True)
+        ok = False
+    if not TrackingProvider.spawned:
+        print("chaos_run: autoscaler never spawned a replica",
+              file=sys.stderr, flush=True)
+        ok = False
+    if ok:
+        print("chaos_run: served %d requests, 0 failed, 0 SLO violations; "
+              "fleet 1→%d→1 (%d scale-outs, %d scale-ins), %d warm spawns "
+              "with 0 cold buckets"
+              % (served[0], peak[0], len(scale_outs), len(scale_ins),
+                 len(cold)), file=sys.stderr, flush=True)
+        shutil.rmtree(tmp, ignore_errors=True)
+    else:
+        print("chaos_run: artifacts kept at %s" % tmp,
+              file=sys.stderr, flush=True)
+    return ok
+
+
 _SCENARIOS = {"membership-churn": run_membership_churn,
-              "serving-failover": run_serving_failover}
+              "serving-failover": run_serving_failover,
+              "flash-crowd": run_flash_crowd}
 
 
 def main():

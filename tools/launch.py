@@ -93,7 +93,68 @@ def _ssh_popen(host, env, command, ssh_port, cwd, extra_keys=()):
          host, remote])
 
 
+def serving_main(argv):
+    """``launch.py --serving``: one warm serving-replica process — the
+    autoscaler's scale-out actuator (ProcessProvider) and the unit a
+    cluster scheduler would run per pod.  Restores the checkpoint with
+    its AOT bundle / compile cache attached (warm start: first request
+    runs with zero cold buckets), serves HTTP, registers + heartbeats
+    into the replica registry so every replicated router discovers it,
+    and installs the SIGTERM preemption handler — scale-in retirement
+    and cluster preemption are the same drain → deregister →
+    postmortem → exit path."""
+    import json
+    import time
+
+    parser = argparse.ArgumentParser(
+        description="Launch one registered serving replica")
+    parser.add_argument("--serving", action="store_true")
+    parser.add_argument("--registry", required=True,
+                        help="replica-registry address (host:port)")
+    parser.add_argument("--name", required=True,
+                        help="registry member name for this replica")
+    parser.add_argument("--prefix", required=True,
+                        help="checkpoint prefix (save_checkpoint files)")
+    parser.add_argument("--epoch", type=int, required=True)
+    parser.add_argument("--input-shapes", required=True,
+                        help='JSON {input_name: [batch, ...]} shapes')
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--http-port", type=int, default=0)
+    parser.add_argument("--no-aot", action="store_true",
+                        help="serve without attaching the AOT bundle "
+                             "(cold warmup compiles)")
+    args = parser.parse_args(argv)
+
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from mxnet_tpu.serving import (InferenceServer, RegistryClient,
+                                   install_preemption_handler,
+                                   start_heartbeater)
+
+    shapes = {k: tuple(v)
+              for k, v in json.loads(args.input_shapes).items()}
+    server = InferenceServer.from_checkpoint(
+        args.prefix, args.epoch, shapes, attach_aot=not args.no_aot)
+    host, port = server.serve_http(args.host, args.http_port)[:2]
+    backend = "%s:%d" % (host, port)
+    registry = RegistryClient(args.registry)
+    stop_beat = start_heartbeater(registry, args.name, backend)
+    install_preemption_handler(server, deregister=stop_beat)
+    print("launch.py: serving replica %s at %s (cold_bucket_runs=%d)"
+          % (args.name, backend, server.cold_bucket_runs()),
+          file=sys.stderr, flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        stop_beat()
+        server.stop(drain=True)
+
+
 def main():
+    if "--serving" in sys.argv[1:]:
+        serving_main(sys.argv[1:])
+        return
     parser = argparse.ArgumentParser(
         description="Launch a distributed job locally",
         usage="launch.py [-h] -n NUM_WORKERS [-s NUM_SERVERS] command ...")
