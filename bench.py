@@ -95,6 +95,12 @@ def _arg_parser():
     ap.add_argument("--coldstart-timeout", type=int, default=300,
                     help="seconds before each cold-start subprocess is "
                          "killed")
+    ap.add_argument("--skip-generate", action="store_true",
+                    help="omit the CPU-only continuous-batching "
+                         "generation phase")
+    ap.add_argument("--generate-timeout", type=int, default=600,
+                    help="seconds before the generation subprocess is "
+                         "killed")
     return ap
 
 
@@ -463,6 +469,47 @@ def _coldstart_fields(timeout=300):
     return fields
 
 
+def _generate_fields(timeout=600):
+    """CPU-only generative-serving phase (tools/bench_generate.py):
+    continuous-batching tokens/s under a mixed-length workload vs the
+    naive sequential full-prefix re-decode baseline (batch=1, no KV),
+    plus TTFT/ITL percentiles, KV-pool peak pages against the
+    live-token bound, and the post-warmup decode compile count (zero or
+    the shape-static decode loop regressed)."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_generate.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run([sys.executable, script],
+                              capture_output=True, text=True,
+                              timeout=timeout, env=env)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        return {"generate_error": str(e)[:300]}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        return {
+            "generate_tokens_per_sec": rec.get("value"),
+            "generate_naive_tokens_per_sec":
+                rec.get("naive_tokens_per_sec"),
+            "generate_speedup_vs_naive": rec.get("speedup_vs_naive"),
+            "generate_outputs_identical": rec.get("outputs_identical"),
+            "generate_ttft_ms_p50": rec.get("ttft_ms_p50"),
+            "generate_ttft_ms_p99": rec.get("ttft_ms_p99"),
+            "generate_itl_ms_p50": rec.get("itl_ms_p50"),
+            "generate_itl_ms_p99": rec.get("itl_ms_p99"),
+            "generate_peak_pages": rec.get("peak_pages"),
+            "generate_live_token_page_bound":
+                rec.get("live_token_page_bound"),
+            "generate_cold_decode_runs": rec.get("cold_decode_runs"),
+        }
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return {"generate_error": "rc=%d %s" % (proc.returncode,
+                                            "; ".join(tail[-2:])[:300])}
+
+
 def _probe_backend(timeout=300):
     """Claim and release the backend in a subprocess. Returns None when
     healthy, else a short error string."""
@@ -507,11 +554,14 @@ def orchestrate(argv=None):
         _shard_probe_fields(cli.shard_probe_timeout)
     coldstart_fields = {} if cli.skip_coldstart else \
         _coldstart_fields(cli.coldstart_timeout)
+    generate_fields = {} if cli.skip_generate else \
+        _generate_fields(cli.generate_timeout)
 
     def finish(rec):
         rec.update(kv_fields)
         rec.update(shard_fields)
         rec.update(coldstart_fields)
+        rec.update(generate_fields)
         print(json.dumps(rec))
         return rec
 

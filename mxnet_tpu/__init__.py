@@ -97,6 +97,7 @@ from . import models
 from . import contrib
 from .predictor import Predictor, load_exported
 from . import serving
+from . import generation
 from .ops import register_pallas_op, Param
 from . import rtc
 from . import torch as th

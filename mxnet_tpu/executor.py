@@ -208,6 +208,12 @@ class Executor:
         self._output_arrays: List = []
         self._monitor_callback = None
         self._jit_cache: Dict[Any, Any] = {}
+        # compile-cache entry label for this executor's forwards ("fwd" by
+        # default); specialized call sites (the generation decode step sets
+        # "gen-step", its prefill "gen-prefill") override it so their
+        # entries are both distinctly keyed and legible in
+        # `compile_cache_admin.py ls`
+        self._cache_kind = "fwd"
         # NaiveEngine parity: MXNET_ENGINE_TYPE=NaiveEngine disables jit and
         # synchronizes after every call (threaded_engine.h:329-337 debugging).
         self._naive = env("MXNET_ENGINE_TYPE") == "NaiveEngine"
@@ -279,7 +285,8 @@ class Executor:
     def _get_fwd(self, is_train: bool, internals: bool = False):
         import jax
 
-        key = ("fwd", is_train, internals)
+        kind = self._cache_kind
+        key = (kind, is_train, internals)
         if key not in self._jit_cache:
             plan = self._plan
 
@@ -296,7 +303,7 @@ class Executor:
                 from . import compile_cache as _cc
 
                 self._jit_cache[key] = _cc.maybe_cached(
-                    jax.jit(fn), "fwd", key, self)
+                    jax.jit(fn), kind, key, self)
         return self._jit_cache[key]
 
     def _get_fwd_bwd(self, is_train: bool, diff_names: tuple, add_names: tuple):

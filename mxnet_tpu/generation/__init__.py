@@ -1,0 +1,15 @@
+"""Generative serving — continuous batching + paged KV-cache.
+
+``kv_pool``: fixed-size KV pages + per-sequence page tables, so KV
+memory scales with live tokens instead of max_len x batch.
+``engine``: :class:`DecodeEngine`, iteration-level continuous batching
+over fixed-shape per-lane-bucket decode executables (admit/retire every
+step, zero post-warmup recompiles, streaming :class:`GenStream`
+handles).  Serving integration (``generate`` SLO class, ``POST
+/generate`` token streaming) lives in ``mxnet_tpu.serving``.
+"""
+from .engine import DecodeEngine, GenStream
+from .kv_pool import KVPoolExhaustedError, PagedKVPool
+
+__all__ = ["DecodeEngine", "GenStream", "PagedKVPool",
+           "KVPoolExhaustedError"]

@@ -1,0 +1,724 @@
+"""DecodeEngine — iteration-level continuous batching over paged KV.
+
+Autoregressive serving has two phases with opposite shapes: *prefill*
+(one big parallel pass over the prompt) and *decode* (one token per
+sequence per step, forever).  Request-level batching couples both to
+the slowest member of a batch; iteration-level ("continuous") batching
+instead re-forms the batch EVERY decode step — new sequences are
+admitted into free lanes the moment prefill finishes, finished ones
+retire immediately — so short requests never wait for long ones and
+the decode executable stays saturated (Orca / vLLM, PAPERS.md).
+
+XLA discipline: every XLA-visible shape here is static.
+
+* Prefill runs through one :class:`~mxnet_tpu.serving.batcher.
+  BucketedPredictor` per prompt-length bucket (pow2 lengths), i.e. the
+  same shape-quantized executables the scoring tier uses.
+* Decode is a fixed-lane slotted program (``models.transformer.
+  get_transformer_lm_decode``): ``lanes`` sequences advance one token
+  through per-lane page tables into a shared paged KV pool
+  (:mod:`.kv_pool`), compiled ONCE per lane-count bucket and primed
+  through the PR 10 compile cache (entry kind ``gen-step`` /
+  ``gen-prefill``), so AOT bundles restore a generate-ready replica
+  with zero cold compiles.
+
+Backpressure: admission is a bounded pending queue (reject =
+:class:`~mxnet_tpu.serving.batcher.QueueFullError`, the HTTP 429/503
+contract) plus KV-pool capacity; a mid-decode pool exhaustion preempts
+the youngest lane (its pages are freed, the sequence re-queues for
+re-prefill of prompt+generated — greedy decode is deterministic, so
+the stream continues seamlessly), which bounds memory without ever
+deadlocking.
+"""
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import faults
+from .. import telemetry as _telemetry
+from ..base import MXNetError, env, register_env
+from ..serving.batcher import (BucketedPredictor, DeadlineExceededError,
+                               QueueFullError, ServerClosedError,
+                               pow2_buckets)
+from .kv_pool import KVPoolExhaustedError, PagedKVPool
+
+__all__ = ["DecodeEngine", "GenStream"]
+
+register_env("MXNET_GEN_PAGE_SIZE", 16, int,
+             "KV-pool page size (tokens per page) for DecodeEngine.")
+register_env("MXNET_GEN_NUM_PAGES", 128, int,
+             "KV-pool page count (page 0 is reserved scratch) for "
+             "DecodeEngine.")
+register_env("MXNET_GEN_MAX_LANES", 8, int,
+             "Largest decode lane-count bucket (max sequences advancing "
+             "per decode step).")
+register_env("MXNET_GEN_MAX_NEW_TOKENS", 64, int,
+             "Default generation budget when a request does not say.")
+register_env("MXNET_GEN_PENDING_QUEUE", 256, int,
+             "Bounded admission queue for DecodeEngine.submit; beyond it "
+             "submissions raise QueueFullError (HTTP 429).")
+
+_DONE = object()  # GenStream queue sentinel
+
+
+class GenStream:
+    """One request's streaming handle: iterate tokens as they decode.
+
+    ``for tok in stream`` yields generated token ids incrementally;
+    :meth:`result` blocks for the full list.  ``ttft_ms`` / ``itl_ms``
+    expose this request's observed first-token latency and inter-token
+    gaps once available."""
+
+    def __init__(self, prompt, max_new_tokens):
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.tokens: List[int] = []
+        self.ttft_ms: Optional[float] = None
+        self.itl_ms: List[float] = []
+        self._t0 = time.monotonic()
+        self._t_last = None
+        self._q: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+        self._exc: Optional[BaseException] = None
+
+    # -- engine side ------------------------------------------------------
+    def _emit(self, token: int) -> float:
+        """Record one generated token; returns the gap (ms) it observed
+        (TTFT for the first token, ITL after)."""
+        now = time.monotonic()
+        if self._t_last is None:
+            gap = (now - self._t0) * 1e3
+            self.ttft_ms = gap
+        else:
+            gap = (now - self._t_last) * 1e3
+            self.itl_ms.append(gap)
+        self._t_last = now
+        self.tokens.append(int(token))
+        self._q.put(int(token))
+        return gap
+
+    def _finish(self, exc: Optional[BaseException] = None):
+        if self._done.is_set():
+            return
+        self._exc = exc
+        self._done.set()
+        self._q.put(_DONE)
+
+    # -- consumer side ----------------------------------------------------
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def exception(self) -> Optional[BaseException]:
+        return self._exc
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is _DONE:
+                if self._exc is not None:
+                    raise self._exc
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation still running")
+        if self._exc is not None:
+            raise self._exc
+        return list(self.tokens)
+
+
+class _Seq:
+    """Engine-internal live-sequence state (one decode lane's occupant)."""
+
+    __slots__ = ("sid", "stream", "tokens", "gen_count", "max_new",
+                 "deadline", "eos_id", "admitted_at")
+
+    def __init__(self, sid, stream, deadline, eos_id):
+        self.sid = sid
+        self.stream = stream
+        self.tokens = list(stream.prompt)  # prompt + generated so far
+        self.gen_count = len(stream.tokens)
+        self.max_new = stream.max_new_tokens
+        self.deadline = deadline  # absolute monotonic seconds or None
+        self.eos_id = eos_id
+        self.admitted_at = 0.0
+
+
+class _GenMetrics:
+    """Telemetry collector for one engine: token throughput, TTFT/ITL
+    histograms, admission/retire/preempt counters, lane occupancy."""
+
+    def __init__(self):
+        reg = self._registry = _telemetry.Registry()
+        self.tokens = reg.counter("mxtpu_gen_tokens_total")
+        self.admitted = reg.counter("mxtpu_gen_sequences_admitted_total")
+        self.retired = reg.counter("mxtpu_gen_sequences_retired_total")
+        self.preempted = reg.counter("mxtpu_gen_sequences_preempted_total")
+        self.expired = reg.counter("mxtpu_gen_sequences_expired_total")
+        self.rejected = reg.counter("mxtpu_gen_sequences_rejected_total")
+        self.failed = reg.counter("mxtpu_gen_sequences_failed_total")
+        self.steps = reg.counter("mxtpu_gen_decode_steps_total")
+        self.cold_steps = reg.counter("mxtpu_gen_decode_cold_steps_total")
+        # 0.5ms .. ~16s exponential buckets
+        self.ttft = reg.histogram("mxtpu_gen_ttft_ms")
+        self.itl = reg.histogram("mxtpu_gen_itl_ms")
+        self.g_active = reg.gauge("mxtpu_gen_active_lanes")
+        self.g_pending = reg.gauge("mxtpu_gen_pending_requests")
+        _telemetry.register_collector(self)
+
+    def render_prometheus(self):
+        return self._registry.render_prometheus()
+
+
+class DecodeEngine:
+    """Continuous-batching generation over a decoder-only LM checkpoint.
+
+    Parameters
+    ----------
+    params : dict | str
+        ``{name: array}`` (``arg:`` prefixes allowed) or a ``.params``
+        path — the ``get_transformer_lm`` training checkpoint; all
+        prefill/decode executors share one copy of the weights.
+    vocab_size, num_layers, num_heads, hidden, max_seq_len
+        Model geometry (must match the checkpoint).
+    lane_buckets : sequence of int, optional
+        Decode lane-count buckets (default ``pow2_buckets(
+        MXNET_GEN_MAX_LANES)``); one executable per bucket.
+    page_size, num_pages : int, optional
+        KV-pool geometry (``MXNET_GEN_PAGE_SIZE`` / ``_NUM_PAGES``).
+    prefill_len_buckets, prefill_batch_buckets
+        Prompt-length and prefill-batch shape quantization; one
+        :class:`BucketedPredictor` per length bucket.
+    eos_id : int, optional
+        Token id that ends a sequence early.
+    """
+
+    def __init__(self, params, vocab_size, num_layers=4, num_heads=8,
+                 hidden=512, max_seq_len=128,
+                 lane_buckets: Optional[Sequence[int]] = None,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 prefill_len_buckets: Optional[Sequence[int]] = None,
+                 prefill_batch_buckets: Sequence[int] = (1, 2, 4),
+                 eos_id: Optional[int] = None,
+                 max_pending: Optional[int] = None,
+                 ctx=None, dtype=np.float32, warmup: bool = True,
+                 start: bool = True):
+        from .. import ndarray as nd
+        from ..models.transformer import (get_transformer_lm_decode,
+                                          get_transformer_lm_prefill)
+        from ..predictor import Predictor
+
+        self.vocab_size = int(vocab_size)
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.hidden = int(hidden)
+        self.max_seq_len = int(max_seq_len)
+        self.head_dim = self.hidden // self.num_heads
+        self.eos_id = eos_id
+        self._ctx = ctx
+        self._dtype = np.dtype(dtype)
+        self.page_size = int(env("MXNET_GEN_PAGE_SIZE", 16, int)
+                             if page_size is None else page_size)
+        self.num_pages = int(env("MXNET_GEN_NUM_PAGES", 128, int)
+                             if num_pages is None else num_pages)
+        self.max_pages = -(-self.max_seq_len // self.page_size)
+        self.lane_buckets = tuple(sorted(set(
+            int(b) for b in (lane_buckets if lane_buckets is not None
+                             else pow2_buckets(
+                                 env("MXNET_GEN_MAX_LANES", 8, int))))))
+        self.max_lanes = self.lane_buckets[-1]
+        if prefill_len_buckets is None:
+            prefill_len_buckets = [b for b in pow2_buckets(self.max_seq_len)
+                                   if b >= min(8, self.max_seq_len)]
+        self.prefill_len_buckets = tuple(sorted(set(
+            int(b) for b in prefill_len_buckets)))
+        self.prefill_batch_buckets = tuple(sorted(set(
+            int(b) for b in prefill_batch_buckets)))
+        self.max_pending = int(env("MXNET_GEN_PENDING_QUEUE", 256, int)
+                               if max_pending is None else max_pending)
+        self.default_max_new = env("MXNET_GEN_MAX_NEW_TOKENS", 64, int)
+
+        if isinstance(params, str):
+            params = nd.load(params)
+        # one shared copy of the weights: Predictor passes live NDArrays
+        # through rebinds, so every bucket executor binds the same arrays
+        self._params = dict(params)
+
+        self.pool = PagedKVPool(self.num_pages, self.page_size,
+                                self.num_layers, self.num_heads,
+                                self.head_dim, dtype=self._dtype)
+        self.metrics = _GenMetrics()
+
+        # prefill: one BucketedPredictor per prompt-length bucket.
+        # Symbols build under a fresh NameManager so auto-generated op
+        # names — and with them symbol.tojson(), the compile-cache graph
+        # fingerprint — are independent of process construction history:
+        # an engine restored from an AOT bundle must re-derive the same
+        # digests the bundle was saved under.
+        from ..name import NameManager
+
+        self._prefill: Dict[int, BucketedPredictor] = {}
+        for L in self.prefill_len_buckets:
+            with NameManager():
+                symbol = get_transformer_lm_prefill(
+                    self.vocab_size, self.num_layers, self.num_heads,
+                    self.hidden, seq_len=L, max_seq_len=self.max_seq_len)
+            bp = BucketedPredictor(symbol, self._params, {"data": (L,)},
+                                   self.prefill_batch_buckets, ctx=ctx,
+                                   dtype=dtype)
+            for pred in bp._preds.values():
+                pred._exec._cache_kind = "gen-prefill"
+            self._prefill[L] = bp
+
+        # decode: one fixed-lane Predictor per lane bucket (shared weights
+        # via reshape; pool shapes are lane-independent)
+        with NameManager():
+            dec_symbol = get_transformer_lm_decode(
+                self.vocab_size, self.num_layers, self.num_heads,
+                self.hidden, max_seq_len=self.max_seq_len,
+                lanes=self.max_lanes, num_pages=self.num_pages,
+                page_size=self.page_size, max_pages=self.max_pages)
+        pool_shape = (self.num_pages, self.page_size, self.num_heads,
+                      self.head_dim)
+        shapes = {"data": (self.max_lanes,),
+                  "positions": (self.max_lanes,),
+                  "page_table": (self.max_lanes, self.max_pages)}
+        for i in range(self.num_layers):
+            shapes["layer%d_k_pool" % i] = pool_shape
+            shapes["layer%d_v_pool" % i] = pool_shape
+        base = Predictor(dec_symbol, self._params, shapes, ctx=ctx,
+                         dtype=dtype)
+        self._decode: Dict[int, Predictor] = {self.max_lanes: base}
+        for b in self.lane_buckets[:-1]:
+            self._decode[b] = base.reshape(
+                {"data": (b,), "positions": (b,), "page_table": (b,
+                 self.max_pages)})
+        for pred in self._decode.values():
+            pred._exec._cache_kind = "gen-step"
+
+        # recompile-detector bookkeeping: lane buckets warmup compiled,
+        # post-warmup steps that hit a novel (never-warmed) bucket
+        self.warmed_lane_buckets = set()
+        self._warned_lane_buckets = set()
+        self.decode_cold_runs = 0
+
+        self._cv = threading.Condition()
+        self._pending: deque = deque()  # _Seq, FIFO (preempted go front)
+        self._active: List[_Seq] = []
+        self._sid = 0
+        self._closed = False
+        self._drain = True
+        self._loop_thread = threading.Thread(
+            target=self._loop, name="mxtpu-gen-engine", daemon=True)
+        self._started = False
+        if warmup:
+            self.warmup()
+        if start:
+            self.start()
+
+    # -- construction helpers ---------------------------------------------
+    def spec(self) -> Dict:
+        """Model/engine geometry needed to rebuild this engine against a
+        new checkpoint (hot-swap, AOT warmup manifests, shadow replicas)."""
+        return {
+            "vocab_size": self.vocab_size, "num_layers": self.num_layers,
+            "num_heads": self.num_heads, "hidden": self.hidden,
+            "max_seq_len": self.max_seq_len,
+            "lane_buckets": list(self.lane_buckets),
+            "page_size": self.page_size, "num_pages": self.num_pages,
+            "prefill_len_buckets": list(self.prefill_len_buckets),
+            "prefill_batch_buckets": list(self.prefill_batch_buckets),
+            "eos_id": self.eos_id, "max_pending": self.max_pending,
+        }
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, **spec):
+        """Build from ``save_checkpoint`` files; ``spec`` as for the
+        constructor (see :meth:`spec`)."""
+        return cls("%s-%04d.params" % (prefix, int(epoch)), **spec)
+
+    def warmup(self):
+        """Pre-compile every prefill (length x batch) bucket and every
+        decode lane bucket, priming through the compile cache when it is
+        enabled — post-warmup steady state performs ZERO XLA compiles,
+        and an attached AOT bundle makes warmup deserialize-only."""
+        for bp in self._prefill.values():
+            bp.warmup()
+        pool_shape = (self.num_pages, self.page_size, self.num_heads,
+                      self.head_dim)
+        zero_pool = np.zeros(pool_shape, self._dtype)
+        for b in self.lane_buckets:
+            pred = self._decode[b]
+            pred.set_input("data", np.zeros((b,), self._dtype))
+            pred.set_input("positions", np.zeros((b,), self._dtype))
+            pred.set_input("page_table",
+                           np.zeros((b, self.max_pages), self._dtype))
+            for i in range(self.num_layers):
+                pred.set_input("layer%d_k_pool" % i, zero_pool)
+                pred.set_input("layer%d_v_pool" % i, zero_pool)
+            pred._exec.forward(is_train=False)
+            for out in pred.get_outputs():
+                out.asnumpy()  # block until compiled + ran
+            self.warmed_lane_buckets.add(b)
+        return self
+
+    def compiled_entries(self):
+        """Primed compile-cache wrappers across prefill and decode
+        executors (kinds ``gen-prefill`` / ``gen-step``) — the input to
+        ``checkpoint.save_aot_bundle`` so an autoscaled replica serves
+        its first generate request with zero cold compiles."""
+        from ..compile_cache import CachedFunction
+
+        out = []
+        for bp in self._prefill.values():
+            out.extend(bp.compiled_entries())
+        for pred in self._decode.values():
+            for fn in pred._exec._jit_cache.values():
+                if isinstance(fn, CachedFunction):
+                    out.append(fn)
+        return out
+
+    def cold_decode_runs(self) -> int:
+        """Post-warmup decode steps that hit a never-warmed lane bucket
+        plus cold prefill flushes — 0 is the "steady state never
+        recompiles" acceptance check."""
+        return self.decode_cold_runs + sum(bp.cold_runs
+                                           for bp in self._prefill.values())
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if not self._started:
+            self._started = True
+            self._loop_thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = 30.0):
+        """Stop the engine.  With ``drain`` (default) queued and active
+        sequences finish first (bounded by ``timeout`` seconds), without
+        it they fail fast with :class:`ServerClosedError`."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._drain = drain
+            if not drain:
+                self._fail_all_locked(ServerClosedError(
+                    "engine stopped before completion"))
+            self._cv.notify_all()
+        if self._started:
+            self._loop_thread.join(timeout)
+        with self._cv:
+            # drain deadline expired with work outstanding (or fail-fast
+            # stop racing the loop): cancel whatever is left
+            self._fail_all_locked(ServerClosedError("engine stopped"))
+
+    def _fail_all_locked(self, exc):
+        n = 0
+        for seq in list(self._pending) + list(self._active):
+            self.pool.free(seq.sid)
+            seq.stream._finish(exc)
+            n += 1
+        self._pending.clear()
+        del self._active[:]
+        if n:
+            self.metrics.failed.inc(n)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=True)
+
+    # -- request path ------------------------------------------------------
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> GenStream:
+        """Queue one prompt for generation; returns its
+        :class:`GenStream`.  Raises :class:`QueueFullError` when the
+        pending queue is at capacity (HTTP 429 — retry with backoff) and
+        :class:`MXNetError` for prompts that can never fit."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise MXNetError("empty prompt")
+        max_new = int(self.default_max_new if max_new_tokens is None
+                      else max_new_tokens)
+        if max_new < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        total = len(prompt) + max_new
+        if total > self.max_seq_len:
+            raise MXNetError(
+                "prompt (%d) + max_new_tokens (%d) exceeds max_seq_len %d"
+                % (len(prompt), max_new, self.max_seq_len))
+        if self.pool.pages_for(total) > self.pool.capacity:
+            raise MXNetError(
+                "request needs %d KV pages but the pool only has %d — it "
+                "can never be admitted" %
+                (self.pool.pages_for(total), self.pool.capacity))
+        stream = GenStream(prompt, max_new)
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        with self._cv:
+            if self._closed:
+                raise ServerClosedError("engine is stopped")
+            if len(self._pending) >= self.max_pending:
+                self.metrics.rejected.inc()
+                raise QueueFullError(
+                    "generation queue full (%d pending); retry with "
+                    "backoff" % len(self._pending))
+            self._pending.append(_Seq(self._sid, stream, deadline,
+                                      self.eos_id))
+            self._sid += 1
+            self.metrics.g_pending.set(len(self._pending))
+            self._cv.notify_all()
+        return stream
+
+    def generate(self, prompt, max_new_tokens=None, deadline_ms=None,
+                 timeout: Optional[float] = 300.0) -> List[int]:
+        """Blocking convenience wrapper: the full generated token list."""
+        return self.submit(prompt, max_new_tokens,
+                           deadline_ms=deadline_ms).result(timeout)
+
+    def pending_depth(self) -> int:
+        with self._cv:
+            return len(self._pending)
+
+    def active_lanes(self) -> int:
+        with self._cv:
+            return len(self._active)
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            return {"pending": len(self._pending),
+                    "active": len(self._active),
+                    "tokens_total": self.metrics.tokens.value,
+                    "cold_decode_runs": self.cold_decode_runs(),
+                    "kv": self.pool.snapshot()}
+
+    # -- engine loop -------------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._pending and not self._active \
+                        and not self._closed:
+                    self._cv.wait(0.05)
+                if self._closed and not self._active and \
+                        (not self._pending or not self._drain):
+                    for seq in self._pending:
+                        seq.stream._finish(ServerClosedError(
+                            "engine stopped before execution"))
+                    self._pending.clear()
+                    return
+            try:
+                self._admit()
+                if self._active:
+                    self._decode_step()
+            except BaseException as exc:  # fault-injected or real: contain
+                logging.warning("generation engine step failed: %r", exc)
+                with self._cv:
+                    self._fail_all_locked(exc)
+                _telemetry.log_event("gen_engine_error", error=repr(exc))
+
+    def _prefill_bucket_for(self, n: int) -> int:
+        for L in self.prefill_len_buckets:
+            if L >= n:
+                return L
+        raise MXNetError("prompt of %d exceeds largest prefill bucket %d"
+                         % (n, self.prefill_len_buckets[-1]))
+
+    def _admit(self):
+        """Move pending sequences into free decode lanes: allocate KV
+        pages, run bucketed prefill, stream each sequence's first token."""
+        batch: List[_Seq] = []
+        now = time.monotonic()
+        free_pages = self.pool.free_pages()
+        with self._cv:
+            while self._pending and \
+                    len(self._active) + len(batch) < self.max_lanes:
+                seq = self._pending[0]
+                if seq.deadline is not None and now > seq.deadline:
+                    self._pending.popleft()
+                    self.metrics.expired.inc()
+                    seq.stream._finish(DeadlineExceededError(
+                        "request waited past its TTFT deadline"))
+                    continue
+                need = self.pool.pages_for(len(seq.tokens))
+                if need > free_pages:
+                    break  # wait for active lanes to retire/free pages
+                free_pages -= need
+                self._pending.popleft()
+                batch.append(seq)
+            self.metrics.g_pending.set(len(self._pending))
+        if not batch:
+            return
+        faults.fire("generation.engine.admit")
+        # group by prompt-length bucket, chunk to the prefill batch cap
+        by_bucket: Dict[int, List[_Seq]] = {}
+        for seq in batch:
+            by_bucket.setdefault(
+                self._prefill_bucket_for(len(seq.tokens)), []).append(seq)
+        for L, seqs in sorted(by_bucket.items()):
+            bp = self._prefill[L]
+            cap = bp.max_batch_size
+            for ofs in range(0, len(seqs), cap):
+                self._prefill_group(L, seqs[ofs:ofs + cap])
+
+    def _prefill_group(self, L: int, seqs: List[_Seq]):
+        bp = self._prefill[L]
+        items = []
+        admitted = []
+        for seq in seqs:
+            try:
+                self.pool.alloc(seq.sid, len(seq.tokens))
+            except KVPoolExhaustedError:
+                # admission raced a concurrent consumer: wait a round
+                with self._cv:
+                    self._pending.appendleft(seq)
+                continue
+            buf = np.zeros((L,), self._dtype)
+            buf[:len(seq.tokens)] = seq.tokens
+            items.append({"data": buf})
+            admitted.append(seq)
+        seqs = admitted
+        if not seqs:
+            return
+        _, results = bp.forward_batch(items)
+        now_active = []
+        for seq, outs in zip(seqs, results):
+            n = len(seq.tokens)
+            logits = outs[0]  # (L, vocab)
+            for layer in range(self.num_layers):
+                self.pool.write_prefill(seq.sid, layer,
+                                        outs[1 + 2 * layer],
+                                        outs[2 + 2 * layer], n)
+            tok = int(np.argmax(logits[n - 1]))
+            self._emit(seq, tok)
+            seq.admitted_at = time.monotonic()
+            now_active.append(seq)
+        with self._cv:
+            self._active.extend(s for s in now_active
+                                if not s.stream.done)
+            self.metrics.admitted.inc(len(now_active))
+            self.metrics.g_active.set(len(self._active))
+
+    def _emit(self, seq: _Seq, tok: int):
+        """Stream one generated token; retires the sequence when it hit
+        its budget or EOS.  Returns True when the sequence retired."""
+        first = not seq.stream.tokens
+        gap = seq.stream._emit(tok)
+        seq.tokens.append(tok)
+        seq.gen_count += 1
+        self.metrics.tokens.inc()
+        (self.metrics.ttft if first else self.metrics.itl).observe(gap)
+        if seq.gen_count >= seq.max_new or \
+                (seq.eos_id is not None and tok == seq.eos_id):
+            self._retire(seq)
+            return True
+        return False
+
+    def _retire(self, seq: _Seq):
+        faults.fire("generation.engine.retire")
+        self.pool.free(seq.sid)
+        seq.stream._finish(None)
+        self.metrics.retired.inc()
+
+    def _preempt_one(self, exclude: Optional[_Seq] = None) -> bool:
+        """Free the youngest active lane's pages and push the sequence
+        back to the FRONT of the pending queue for re-prefill of
+        prompt + generated-so-far (greedy decode is deterministic, so
+        its stream continues without a hiccup)."""
+        with self._cv:
+            victims = [s for s in self._active if s is not exclude]
+            if not victims:
+                victims = [s for s in self._active]
+            if not victims:
+                return False
+            victim = max(victims, key=lambda s: s.admitted_at)
+            self._active.remove(victim)
+            self._pending.appendleft(victim)
+            self.metrics.g_active.set(len(self._active))
+            self.metrics.g_pending.set(len(self._pending))
+        self.pool.free(victim.sid)
+        self.metrics.preempted.inc()
+        _telemetry.log_event("gen_preempt", sid=victim.sid,
+                             tokens=len(victim.tokens))
+        return True
+
+    def _lane_bucket_for(self, n: int) -> int:
+        for b in self.lane_buckets:
+            if b >= n:
+                return b
+        raise MXNetError("%d active lanes exceed largest bucket %d"
+                         % (n, self.lane_buckets[-1]))
+
+    def _decode_step(self):
+        """One continuous-batching iteration: every active lane advances
+        one token through the fixed-shape paged-attention executable."""
+        faults.fire("generation.engine.step")
+        # grow each lane's KV allocation for the token about to be
+        # written; pool exhaustion preempts the youngest other lane
+        for seq in list(self._active):
+            # an earlier lane's extend may have preempted this one already
+            while seq in self._active:
+                try:
+                    self.pool.extend(seq.sid, len(seq.tokens))
+                    break
+                except KVPoolExhaustedError:
+                    if not self._preempt_one(exclude=seq):
+                        raise
+        active = list(self._active)
+        if not active:
+            return
+        b = self._lane_bucket_for(len(active))
+        if b not in self.warmed_lane_buckets:
+            self.decode_cold_runs += 1
+            self.metrics.cold_steps.inc()
+            self.warmed_lane_buckets.add(b)
+            if b not in self._warned_lane_buckets:
+                self._warned_lane_buckets.add(b)
+                logging.warning(
+                    "generation: decode step hit never-warmed lane bucket "
+                    "%d post-warmup (fresh XLA compile on the serving "
+                    "path) — add it to lane_buckets/warmup", b)
+                _telemetry.log_event("gen_decode_cold_bucket", lanes=b)
+        pred = self._decode[b]
+        data = np.zeros((b,), self._dtype)
+        positions = np.zeros((b,), self._dtype)
+        table = np.zeros((b, self.max_pages), self._dtype)
+        for i, seq in enumerate(active):
+            data[i] = seq.tokens[-1]
+            positions[i] = len(seq.tokens) - 1  # slot the new K/V lands in
+            table[i] = self.pool.page_table_row(seq.sid, self.max_pages)
+        pred.set_input("data", data)
+        pred.set_input("positions", positions)
+        pred.set_input("page_table", table)
+        for i in range(self.num_layers):
+            pred.set_input("layer%d_k_pool" % i, self.pool.k_pools[i])
+            pred.set_input("layer%d_v_pool" % i, self.pool.v_pools[i])
+        pred._exec.forward(is_train=False)
+        outs = [o.asnumpy() for o in pred.get_outputs()]
+        logits = outs[0]
+        for i in range(self.num_layers):
+            np.copyto(self.pool.k_pools[i], outs[1 + 2 * i])
+            np.copyto(self.pool.v_pools[i], outs[2 + 2 * i])
+        self.metrics.steps.inc()
+        retired = []
+        for i, seq in enumerate(active):
+            if self._emit(seq, int(np.argmax(logits[i]))):
+                retired.append(seq)
+        if retired:
+            with self._cv:
+                for seq in retired:
+                    if seq in self._active:
+                        self._active.remove(seq)
+                self.metrics.g_active.set(len(self._active))
+                self._cv.notify_all()

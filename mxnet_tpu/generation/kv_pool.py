@@ -1,0 +1,217 @@
+"""Paged KV-cache pool — fixed-size pages + per-sequence page tables.
+
+The dense alternative (one ``(max_len, heads, head_dim)`` buffer per
+sequence slot) reserves ``max_len x batch`` tokens of HBM whether or not
+they are ever written; mixed-length autoregressive traffic wastes most
+of it.  Here KV storage is a shared pool of fixed-size pages (the vLLM
+PagedAttention layout): a sequence owns ``ceil(len / page_size)`` pages,
+listed in order in its page table, so live memory tracks live tokens and
+the pool admits as many sequences as actually fit.
+
+Page 0 is reserved as scratch: inactive decode lanes point their
+page-table rows at it so their masked-out writes land harmlessly
+(ops/paged.py).  Allocation is O(1) off a free list; exhaustion raises
+:class:`KVPoolExhaustedError` — the engine's admission backpressure and
+preemption signal, never a deadlock.
+
+Watermark accounting (live/peak pages, occupancy) exports through
+``mxnet_tpu.telemetry`` gauges; every allocation passes the
+``generation.kv.alloc`` fault point so chaos runs can starve the pool
+deterministically.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+import numpy as np
+
+from .. import faults
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+
+__all__ = ["PagedKVPool", "KVPoolExhaustedError"]
+
+
+class KVPoolExhaustedError(MXNetError):
+    """No free pages — backpressure: callers queue, shed, or preempt."""
+
+
+class PagedKVPool:
+    """Host-side paged K/V storage for ``num_layers`` attention layers.
+
+    Parameters
+    ----------
+    num_pages : int
+        Total pool pages INCLUDING the reserved scratch page 0, so
+        ``num_pages - 1`` are allocatable.
+    page_size : int
+        Tokens per page.
+    num_layers, num_heads, head_dim : int
+        K/V geometry; each layer holds one ``(num_pages, page_size,
+        num_heads, head_dim)`` K array and one V array.
+    """
+
+    def __init__(self, num_pages, page_size, num_layers, num_heads,
+                 head_dim, dtype=np.float32):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is reserved scratch)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.num_layers = int(num_layers)
+        self._dtype = np.dtype(dtype)
+        shape = (self.num_pages, self.page_size, int(num_heads),
+                 int(head_dim))
+        self.k_pools = [np.zeros(shape, self._dtype)
+                        for _ in range(self.num_layers)]
+        self.v_pools = [np.zeros(shape, self._dtype)
+                        for _ in range(self.num_layers)]
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._tables: Dict[object, List[int]] = {}
+        self._lengths: Dict[object, int] = {}
+        self.peak_pages = 0
+        reg = self._registry = _telemetry.Registry()
+        self._g_live = reg.gauge("mxtpu_gen_kv_pages_live")
+        self._g_peak = reg.gauge("mxtpu_gen_kv_pages_peak")
+        self._g_occ = reg.gauge("mxtpu_gen_kv_pool_occupancy_pct")
+        self._c_allocs = reg.counter("mxtpu_gen_kv_page_allocs_total")
+        self._c_frees = reg.counter("mxtpu_gen_kv_page_frees_total")
+        _telemetry.register_collector(self)
+
+    # -- accounting -------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (scratch page excluded)."""
+        return self.num_pages - 1
+
+    def live_pages(self) -> int:
+        with self._lock:
+            return self.capacity - len(self._free)
+
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def occupancy(self) -> float:
+        return self.live_pages() / float(self.capacity)
+
+    def pages_for(self, num_tokens: int) -> int:
+        return -(-int(num_tokens) // self.page_size)  # ceil div
+
+    def seq_length(self, seq_id) -> int:
+        with self._lock:
+            return self._lengths[seq_id]
+
+    def live_sequences(self) -> int:
+        with self._lock:
+            return len(self._tables)
+
+    def _refresh_gauges_locked(self):
+        live = self.capacity - len(self._free)
+        if live > self.peak_pages:
+            self.peak_pages = live
+        self._g_live.set(live)
+        self._g_peak.set(self.peak_pages)
+        self._g_occ.set(int(round(100.0 * live / self.capacity)))
+
+    # -- alloc / extend / free -------------------------------------------
+    def can_fit(self, num_tokens: int) -> bool:
+        with self._lock:
+            return self.pages_for(num_tokens) <= len(self._free)
+
+    def alloc(self, seq_id, num_tokens: int) -> List[int]:
+        """Claim pages for a new sequence of ``num_tokens`` tokens;
+        returns its page list.  Raises :class:`KVPoolExhaustedError`
+        without allocating anything when the pool cannot fit it."""
+        faults.fire("generation.kv.alloc")
+        need = max(1, self.pages_for(num_tokens))
+        with self._lock:
+            if seq_id in self._tables:
+                raise MXNetError("sequence %r already allocated" % (seq_id,))
+            if need > len(self._free):
+                raise KVPoolExhaustedError(
+                    "KV pool exhausted: need %d pages, %d free (capacity "
+                    "%d); retry, shed, or preempt" %
+                    (need, len(self._free), self.capacity))
+            pages = [self._free.pop() for _ in range(need)]
+            self._tables[seq_id] = pages
+            self._lengths[seq_id] = int(num_tokens)
+            self._c_allocs.inc(need)
+            self._refresh_gauges_locked()
+            return list(pages)
+
+    def extend(self, seq_id, new_length: int) -> List[int]:
+        """Grow a sequence to ``new_length`` tokens, claiming new pages
+        when it crosses a page boundary.  Raises
+        :class:`KVPoolExhaustedError` (state unchanged) when the pool is
+        out — the engine preempts a sequence to make room."""
+        with self._lock:
+            pages = self._tables.get(seq_id)
+            if pages is None:
+                raise MXNetError("unknown sequence %r" % (seq_id,))
+            need = self.pages_for(new_length) - len(pages)
+            if need > len(self._free):
+                raise KVPoolExhaustedError(
+                    "KV pool exhausted extending %r: need %d more pages, "
+                    "%d free" % (seq_id, need, len(self._free)))
+            for _ in range(max(0, need)):
+                pages.append(self._free.pop())
+            if need > 0:
+                self._c_allocs.inc(need)
+            self._lengths[seq_id] = int(new_length)
+            self._refresh_gauges_locked()
+            return list(pages)
+
+    def free(self, seq_id):
+        """Return a sequence's pages to the free list (idempotent)."""
+        with self._lock:
+            pages = self._tables.pop(seq_id, None)
+            self._lengths.pop(seq_id, None)
+            if pages:
+                self._free.extend(reversed(pages))
+                self._c_frees.inc(len(pages))
+                self._refresh_gauges_locked()
+
+    # -- page-table / data plumbing for the decode step ------------------
+    def page_table_row(self, seq_id, max_pages: int) -> np.ndarray:
+        """The sequence's page list padded to ``max_pages`` with the
+        scratch page 0 (the decode step's per-lane page-table row)."""
+        with self._lock:
+            pages = self._tables.get(seq_id)
+            if pages is None:
+                raise MXNetError("unknown sequence %r" % (seq_id,))
+            if len(pages) > max_pages:
+                raise MXNetError(
+                    "sequence %r spans %d pages > max_pages %d"
+                    % (seq_id, len(pages), max_pages))
+            row = np.zeros((max_pages,), np.float32)
+            row[:len(pages)] = pages
+            return row
+
+    def write_prefill(self, seq_id, layer, k, v, length: int):
+        """Scatter a prefill pass's K/V (``(seq_len, heads, head_dim)``,
+        only the first ``length`` rows real) into the sequence's pages."""
+        with self._lock:
+            pages = self._tables[seq_id]
+        ps = self.page_size
+        kp, vp = self.k_pools[layer], self.v_pools[layer]
+        for start in range(0, int(length), ps):
+            page = pages[start // ps]
+            n = min(ps, int(length) - start)
+            kp[page, :n] = k[start:start + n]
+            vp[page, :n] = v[start:start + n]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            live = self.capacity - len(self._free)
+            return {"capacity": self.capacity, "live_pages": live,
+                    "peak_pages": self.peak_pages,
+                    "sequences": len(self._tables),
+                    "occupancy": live / float(self.capacity)}
+
+    def render_prometheus(self):
+        """Collector hook for ``telemetry.render_prometheus()``."""
+        return self._registry.render_prometheus()

@@ -75,3 +75,133 @@ def get_transformer_lm(vocab_size=32000, num_layers=4, num_heads=8,
     # symbol like the reference LM examples (example/rnn/lstm_bucketing.py)
     label = sym.Reshape(sym.Variable("softmax_label"), shape=(-1,))
     return sym.SoftmaxOutput(logits, label=label, name="softmax")
+
+
+# ---------------------------------------------------------------------------
+# Generative-serving variants (mxnet_tpu.generation) — same weight names as
+# get_transformer_lm, so one trained checkpoint binds all three symbols.
+# ---------------------------------------------------------------------------
+
+
+def _prefill_block(x, hidden, num_heads, seq_len, name, attn_impl):
+    """Pre-norm block that also RETURNS its (k, v) projections — the
+    prefill pass feeds them into the paged KV pool so decode never
+    recomputes the prefix."""
+    head_dim = hidden // num_heads
+    h = sym.LayerNorm(x, name="%s_ln1" % name)
+    qkv = _dense(h, hidden, 3 * hidden, "%s_qkv" % name)
+    qkv = sym.Reshape(qkv, shape=(-1, seq_len, 3, num_heads, head_dim))
+    q, k, v = sym.SliceChannel(qkv, num_outputs=3, axis=2, squeeze_axis=True,
+                               name="%s_split" % name)
+    if attn_impl == "dense":
+        # dense oracle attention: prefill runs once per sequence and must
+        # be CPU-fast (interpret-mode Pallas is not), TPU still fuses it
+        att = sym._contrib_DenseAttention(q, k, v, causal=True,
+                                          name="%s_attn" % name)
+    elif attn_impl == "flash":
+        att = sym._contrib_FlashAttention(q, k, v, causal=True,
+                                          name="%s_attn" % name)
+    else:
+        raise ValueError("attn_impl must be 'dense' or 'flash', got %r"
+                         % (attn_impl,))
+    att = sym.Reshape(att, shape=(-1, seq_len, hidden))
+    proj = _dense(att, hidden, hidden, "%s_proj" % name)
+    x = sym.broadcast_add(x, sym.Reshape(proj, shape=(-1, seq_len, hidden)),
+                          name="%s_res1" % name)
+    h = sym.LayerNorm(x, name="%s_ln2" % name)
+    h = _dense(h, hidden, 4 * hidden, "%s_fc1" % name)
+    h = sym.gelu(h, name="%s_gelu" % name)
+    h = _dense(h, 4 * hidden, hidden, "%s_fc2" % name)
+    x = sym.broadcast_add(x, sym.Reshape(h, shape=(-1, seq_len, hidden)),
+                          name="%s_res2" % name)
+    return x, k, v
+
+
+def get_transformer_lm_prefill(vocab_size=32000, num_layers=4, num_heads=8,
+                               hidden=512, seq_len=128, max_seq_len=None,
+                               attn_impl="dense"):
+    """Prefill pass for generation: ``data`` (b, seq_len) token ids ->
+    ``Group([logits, k0, v0, k1, v1, ...])`` with logits (b, seq_len,
+    vocab) and per-layer K/V (b, seq_len, heads, head_dim).
+
+    ``seq_len`` is this executable's (bucketed) prompt capacity;
+    ``max_seq_len`` (default ``seq_len``) is the position-table capacity
+    shared with the training symbol — the engine builds one prefill
+    executor per length bucket against one ``pos_embed_weight``.
+    Prompts shorter than ``seq_len`` are right-padded by the caller;
+    causal attention keeps the padding from contaminating real
+    positions, so only outputs at < length are meaningful."""
+    if max_seq_len is None:
+        max_seq_len = seq_len
+    data = sym.Variable("data")
+    pos = sym.Variable("pos_embed_weight", shape=(1, max_seq_len, hidden))
+    if seq_len != max_seq_len:
+        pos = sym.slice_axis(pos, axis=1, begin=0, end=seq_len,
+                             name="pos_slice")
+    x = sym.Embedding(data, input_dim=vocab_size, output_dim=hidden,
+                      name="tok_embed")
+    x = sym.broadcast_add(x, pos, name="pos_add")
+    kvs = []
+    for i in range(num_layers):
+        x, k, v = _prefill_block(x, hidden, num_heads, seq_len,
+                                 "layer%d" % i, attn_impl)
+        kvs.extend([k, v])
+    x = sym.LayerNorm(x, name="ln_f")
+    logits = _dense(x, hidden, vocab_size, "lm_head")
+    logits = sym.Reshape(logits, shape=(-1, seq_len, vocab_size),
+                         name="logits")
+    return sym.Group([logits] + kvs)
+
+
+def get_transformer_lm_decode(vocab_size=32000, num_layers=4, num_heads=8,
+                              hidden=512, max_seq_len=128, lanes=8,
+                              num_pages=64, page_size=16, max_pages=8):
+    """One incremental decode step over paged KV: ``lanes`` sequences
+    advance one token each, reading/writing fixed-size KV pages through
+    per-lane page tables instead of recomputing the prefix.
+
+    Inputs: ``data`` (lanes,) current token ids; ``positions`` (lanes,)
+    absolute positions; ``page_table`` (lanes, max_pages);
+    ``layer%d_k_pool`` / ``layer%d_v_pool`` (num_pages, page_size,
+    heads, head_dim) per layer.  Output: ``Group([logits, k_pool0_out,
+    v_pool0_out, ...])`` with logits (lanes, vocab).  Everything is
+    static-shape, so one executable per lane count serves any mix of
+    sequence lengths — the continuous-batching contract."""
+    head_dim = hidden // num_heads
+    data = sym.Variable("data")
+    positions = sym.Variable("positions")
+    page_table = sym.Variable("page_table")
+    pos_tab = sym.Variable("pos_embed_weight", shape=(1, max_seq_len, hidden))
+    x = sym.Embedding(data, input_dim=vocab_size, output_dim=hidden,
+                      name="tok_embed")
+    pe = sym.Reshape(pos_tab, shape=(max_seq_len, hidden), name="pos_flat")
+    pe = sym.take(pe, positions, name="pos_take")  # (lanes, hidden)
+    x = sym.broadcast_add(x, pe, name="pos_add")
+    pools_out = []
+    for i in range(num_layers):
+        name = "layer%d" % i
+        h = sym.LayerNorm(x, name="%s_ln1" % name)
+        qkv = sym.FullyConnected(h, num_hidden=3 * hidden,
+                                 name="%s_qkv" % name)
+        qkv = sym.Reshape(qkv, shape=(-1, 3, num_heads, head_dim))
+        q, k, v = sym.SliceChannel(qkv, num_outputs=3, axis=1,
+                                   squeeze_axis=True, name="%s_split" % name)
+        k_pool = sym.Variable("%s_k_pool" % name)
+        v_pool = sym.Variable("%s_v_pool" % name)
+        att, k_out, v_out = sym._contrib_PagedAttention(
+            q, k, v, k_pool, v_pool, page_table, positions,
+            page_size=page_size, name="%s_attn" % name)
+        pools_out.extend([k_out, v_out])
+        att = sym.Reshape(att, shape=(-1, hidden))
+        proj = sym.FullyConnected(att, num_hidden=hidden,
+                                  name="%s_proj" % name)
+        x = sym.broadcast_add(x, proj, name="%s_res1" % name)
+        h = sym.LayerNorm(x, name="%s_ln2" % name)
+        h = sym.FullyConnected(h, num_hidden=4 * hidden,
+                               name="%s_fc1" % name)
+        h = sym.gelu(h, name="%s_gelu" % name)
+        h = sym.FullyConnected(h, num_hidden=hidden, name="%s_fc2" % name)
+        x = sym.broadcast_add(x, h, name="%s_res2" % name)
+    x = sym.LayerNorm(x, name="ln_f")
+    logits = sym.FullyConnected(x, num_hidden=vocab_size, name="lm_head")
+    return sym.Group([logits] + pools_out)

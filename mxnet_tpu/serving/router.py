@@ -106,6 +106,14 @@ register_env("MXNET_SERVING_PROBE_FAILURES", 3, int,
 register_env("MXNET_SERVING_REGISTRY_SYNC_MS", 500.0, float,
              "Period at which a registry-attached router re-syncs its "
              "replica set against the shared live set.")
+register_env("MXNET_GEN_TTFT_MS", 0.0, float,
+             "Time-to-first-token budget (ms) of the default 'generate' "
+             "SLO class; 0 means no budget.  Doubles as the admission "
+             "deadline the router passes to the engine's pending queue.")
+register_env("MXNET_GEN_ITL_MS", 0.0, float,
+             "Inter-token-latency budget (ms) of the default 'generate' "
+             "SLO class; 0 means no budget.  Gaps beyond it count in "
+             "mxtpu_router_itl_violations_total.")
 
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
@@ -138,25 +146,38 @@ class SLOClass:
     ``sheddable`` classes are rejected under queue pressure before any
     non-sheddable request is."""
 
-    __slots__ = ("name", "deadline_ms", "priority", "sheddable")
+    __slots__ = ("name", "deadline_ms", "priority", "sheddable",
+                 "ttft_ms", "itl_ms")
 
     def __init__(self, name: str, deadline_ms: Optional[float] = None,
-                 priority: int = 0, sheddable: bool = False):
+                 priority: int = 0, sheddable: bool = False,
+                 ttft_ms: Optional[float] = None,
+                 itl_ms: Optional[float] = None):
         self.name = name
         self.deadline_ms = deadline_ms
         self.priority = int(priority)
         self.sheddable = bool(sheddable)
+        # streaming-generation budgets: a whole-request deadline is the
+        # wrong unit for an open-ended token stream, so the generate
+        # class budgets time-to-first-token and inter-token latency
+        self.ttft_ms = ttft_ms
+        self.itl_ms = itl_ms
 
     def __repr__(self):
-        return ("SLOClass(%r, deadline_ms=%r, priority=%d, sheddable=%s)"
+        return ("SLOClass(%r, deadline_ms=%r, priority=%d, sheddable=%s, "
+                "ttft_ms=%r, itl_ms=%r)"
                 % (self.name, self.deadline_ms, self.priority,
-                   self.sheddable))
+                   self.sheddable, self.ttft_ms, self.itl_ms))
 
 
 def default_slo_classes() -> Dict[str, SLOClass]:
     return {
         "interactive": SLOClass("interactive", priority=0, sheddable=False),
         "batch": SLOClass("batch", priority=1, sheddable=True),
+        "generate": SLOClass(
+            "generate", priority=0, sheddable=False,
+            ttft_ms=env("MXNET_GEN_TTFT_MS", 0.0, float) or None,
+            itl_ms=env("MXNET_GEN_ITL_MS", 0.0, float) or None),
     }
 
 
@@ -202,6 +223,12 @@ class RouterMetrics:
         self._expired = reg.labeled_counter(
             "mxtpu_router_requests_expired", "slo")
         self._retries = reg.counter("mxtpu_router_retries_total")
+        self._streams = reg.labeled_counter(
+            "mxtpu_router_streams_total", "slo")
+        self._stream_resumes = reg.counter(
+            "mxtpu_router_stream_resumes_total")
+        self._itl_violations = reg.counter(
+            "mxtpu_router_itl_violations_total")
         self._hedges = reg.counter("mxtpu_router_hedges_total")
         self._hedge_wins = reg.counter("mxtpu_router_hedge_wins_total")
         self._swaps = reg.counter("mxtpu_router_swaps_total")
@@ -236,6 +263,15 @@ class RouterMetrics:
 
     def on_retry(self):
         self._retries.inc()
+
+    def on_stream(self, slo):
+        self._streams.inc(slo)
+
+    def on_stream_resume(self):
+        self._stream_resumes.inc()
+
+    def on_itl_violation(self):
+        self._itl_violations.inc()
 
     def on_hedge(self):
         self._hedges.inc()
@@ -278,6 +314,9 @@ class RouterMetrics:
             "shed": self._shed.snapshot(),
             "expired": self._expired.snapshot(),
             "retries": self._retries.value,
+            "streams": self._streams.snapshot(),
+            "stream_resumes": self._stream_resumes.value,
+            "itl_violations": self._itl_violations.value,
             "hedges": self._hedges.value,
             "hedge_wins": self._hedge_wins.value,
             "swaps": self._swaps.value,
@@ -447,6 +486,15 @@ class _Replica:
     def call(self, inputs, deadline_ms, request_id, slo):
         raise NotImplementedError
 
+    def supports_generate(self) -> bool:
+        return False
+
+    def generate_stream(self, prompt, max_new_tokens, deadline_ms,
+                        request_id, slo):
+        """Iterator of generated token ids; raising mid-iteration is the
+        resume-on-another-replica signal."""
+        raise NotImplementedError
+
 
 class _LocalReplica(_Replica):
     """An in-process :class:`InferenceServer` behind the router."""
@@ -484,6 +532,15 @@ class _LocalReplica(_Replica):
             raise RouterError(
                 "replica %s timed out after %.0fms (request %s)"
                 % (self.name, timeout_ms, request_id))
+
+    def supports_generate(self):
+        return self.server._generator is not None
+
+    def generate_stream(self, prompt, max_new_tokens, deadline_ms,
+                        request_id, slo):
+        stream = self.server.submit_generate(
+            prompt, max_new_tokens, deadline_ms=deadline_ms)
+        return iter(stream)
 
 
 class _RemoteReplica(_Replica):
@@ -588,6 +645,60 @@ class _RemoteReplica(_Replica):
                                      % (self.name, detail))
             raise RouterError("replica %s HTTP %d: %s"
                               % (self.name, exc.code, detail))
+
+    def supports_generate(self):
+        # not probeable cheaply: assume yes; a generator-less backend
+        # answers 404 which surfaces as RouterError -> failover
+        return True
+
+    def generate_stream(self, prompt, max_new_tokens, deadline_ms,
+                        request_id, slo):
+        import urllib.error
+        import urllib.request
+
+        payload = {"prompt": [int(t) for t in prompt]}
+        if max_new_tokens is not None:
+            payload["max_new_tokens"] = int(max_new_tokens)
+        if deadline_ms is not None:
+            payload["deadline_ms"] = float(deadline_ms)
+        headers = {"Content-Type": "application/json",
+                   "X-Request-Id": request_id, "X-SLO-Class": slo}
+        timeout_ms = env("MXNET_SERVING_CALL_TIMEOUT_MS", 30000.0, float)
+        req = urllib.request.Request(
+            self._base + "/generate", data=json.dumps(payload).encode(),
+            headers=headers)
+        try:
+            resp = urllib.request.urlopen(req, timeout=timeout_ms / 1e3)
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode(errors="replace")[:200]
+            exc.close()
+            if exc.code == 429 or exc.code == 503:
+                raise QueueFullError("replica %s rejected generate: %s"
+                                     % (self.name, detail))
+            if exc.code == 504:
+                raise DeadlineExceededError(detail)
+            raise RouterError("replica %s HTTP %d: %s"
+                              % (self.name, exc.code, detail))
+
+        def _iter():
+            # NDJSON lines, one token each, until the done/error line;
+            # connection close without one means the replica died
+            with resp:
+                done = False
+                for line in resp:
+                    obj = json.loads(line)
+                    if "error" in obj:
+                        raise RouterError("replica %s stream failed: %s"
+                                          % (self.name, obj["error"]))
+                    if obj.get("done"):
+                        done = True
+                        break
+                    yield int(obj["token"])
+                if not done:
+                    raise RouterError(
+                        "replica %s stream closed without done marker"
+                        % self.name)
+        return _iter()
 
     def swap(self, prefix, epoch, timeout=600.0):
         """Remote in-place hot-swap via ``POST /swap`` (the server warms
@@ -843,11 +954,15 @@ class Router:
         p99 = {}
         budget = {}
         for slo, cls in self.slo_classes.items():
-            if cls.deadline_ms is not None:
+            # streaming classes budget TTFT instead of a whole-request
+            # deadline; their latency samples ARE TTFT observations
+            bud = cls.deadline_ms if cls.deadline_ms is not None \
+                else cls.ttft_ms
+            if bud is not None:
                 v = self.metrics.latency_quantile(0.99, slo)
                 if v is not None:
                     p99[slo] = v
-                    budget[slo] = cls.deadline_ms
+                    budget[slo] = bud
         return {
             "pressure": self.pressure(),
             "replicas": len(reps),
@@ -857,6 +972,7 @@ class Router:
                                  if r.state != BREAKER_CLOSED),
             "shed_total": sum(snap["shed"].values()),
             "expired_total": sum(snap["expired"].values()),
+            "stream_resumes": snap["stream_resumes"],
             "p99_ms": p99,
             "deadline_ms": budget,
         }
@@ -917,6 +1033,151 @@ class Router:
         """Blocking convenience wrapper around :meth:`submit`."""
         return self.submit(slo=slo, deadline_ms=deadline_ms,
                            **inputs).result()
+
+    def generate(self, prompt: Sequence[int],
+                 max_new_tokens: Optional[int] = None,
+                 slo: str = "generate",
+                 deadline_ms: Optional[float] = None,
+                 request_id: Optional[str] = None):
+        """Stream generated tokens through the fleet: returns an
+        iterator of token ids, resumable across replica failures.
+
+        The stream dispatches to a generate-capable replica
+        (power-of-two-choices, breakers respected); if the replica dies
+        MID-STREAM the router resumes on another one by re-submitting
+        ``prompt + tokens emitted so far`` (greedy decode is
+        deterministic, so the client-visible stream continues seamlessly
+        with zero duplicated or dropped tokens —
+        ``mxtpu_router_stream_resumes_total`` counts the seams).  Hedging
+        is not applied to streams: a duplicated stream would decode the
+        same tokens twice for no tail-latency win on an open-ended
+        response; failover covers the slow-replica case instead.
+
+        ``deadline_ms`` (default: the class ``ttft_ms``) bounds
+        ADMISSION — time queued before the first token — not the whole
+        stream; inter-token gaps beyond the class ``itl_ms`` budget
+        count in ``mxtpu_router_itl_violations_total``.  Raises
+        :class:`RouterOverloadError` synchronously when the class is
+        shed; the iterator raises :class:`NoReplicaAvailableError` when
+        every capable replica failed."""
+        if self._closed:
+            raise ServerClosedError("router is closed")
+        cls = self.slo_classes.get(slo)
+        if cls is None:
+            raise MXNetError("unknown SLO class %r (one of %s)"
+                             % (slo, sorted(self.slo_classes)))
+        pressure = self.pressure()
+        if cls.sheddable and pressure >= self.shed_pressure:
+            self.metrics.on_shed(slo)
+            _telemetry.log_event("router_shed", slo=slo,
+                                 pressure=round(pressure, 3))
+            raise RouterOverloadError(
+                "shedding %r traffic at %.0f%% queue pressure"
+                % (slo, pressure * 100))
+        if max_new_tokens is None:
+            max_new_tokens = env("MXNET_GEN_MAX_NEW_TOKENS", 64, int)
+        rid = request_id if request_id is not None \
+            else "gen-%d" % next(self._rid)
+        self.metrics.on_submit(slo)
+        self.metrics.on_stream(slo)
+        return self._generate_iter(cls, rid, prompt, int(max_new_tokens),
+                                   deadline_ms)
+
+    def _generate_iter(self, cls, rid, prompt, max_new, deadline_ms):
+        t0 = time.monotonic()
+        cur = [int(t) for t in prompt]
+        remaining = max_new
+        emitted = 0
+        failures = 0
+        last_exc = None
+        ttft_budget = deadline_ms if deadline_ms is not None \
+            else cls.ttft_ms
+        itl_budget = cls.itl_ms
+        while remaining > 0:
+            faults.fire("serving.router.dispatch")
+            tried = set()
+            rep = None
+            while True:
+                cand = self._pick(tried)
+                if cand is None:
+                    break
+                tried.add(cand.name)
+                if cand.supports_generate():
+                    rep = cand
+                    break
+                cand.release()
+            if rep is None:
+                self.metrics.on_fail(cls.name)
+                raise NoReplicaAvailableError(
+                    "generate %s: no generate-capable replica (tried %s):"
+                    " %r" % (rid, sorted(tried) or "none", last_exc)) \
+                    from last_exc
+            rep.begin_call()
+            ok = None
+            t_call = time.monotonic()
+            made_progress = False
+            try:
+                faults.fire("serving.replica.call")
+                faults.fire("serving.replica.%s.call" % rep.name)
+                stream = rep.generate_stream(
+                    cur, remaining,
+                    ttft_budget if emitted == 0 else None, rid, cls.name)
+                t_prev = time.monotonic()
+                for tok in stream:
+                    now = time.monotonic()
+                    if emitted == 0:
+                        # TTFT is the stream's per-SLO latency sample
+                        self.metrics.on_complete(cls.name,
+                                                 (now - t0) * 1e3)
+                    elif itl_budget and (now - t_prev) * 1e3 > itl_budget:
+                        self.metrics.on_itl_violation()
+                    t_prev = now
+                    tok = int(tok)
+                    cur.append(tok)
+                    emitted += 1
+                    remaining -= 1
+                    made_progress = True
+                    failures = 0
+                    yield tok
+                    if remaining <= 0:
+                        break
+                if remaining > 0 and not made_progress:
+                    raise RouterError(
+                        "replica %s returned an empty stream" % rep.name)
+                ok = True
+            except DeadlineExceededError:
+                ok = None  # admission budget died, not the replica
+                self.metrics.on_expire(cls.name)
+                raise
+            except QueueFullError as exc:
+                ok = None  # load signal, breaker-neutral
+                last_exc = exc
+                failures += 1
+            except GeneratorExit:
+                ok = None  # consumer abandoned the stream
+                raise
+            except BaseException as exc:
+                ok = False
+                last_exc = exc
+                failures += 1
+            finally:
+                rep.end_call(ok, (time.monotonic() - t_call) * 1e3)
+            if ok:
+                return  # budget reached or EOS: clean end of stream
+            if failures > self.retries:
+                self.metrics.on_fail(cls.name)
+                raise NoReplicaAvailableError(
+                    "generate %s failed after %d attempts: %r"
+                    % (rid, failures, last_exc)) from last_exc
+            # resume on another replica: re-submit prompt + emitted
+            # tokens (deterministic greedy decode -> seamless stream)
+            if made_progress or emitted:
+                self.metrics.on_stream_resume()
+            else:
+                self.metrics.on_retry()
+            _telemetry.log_event("router_stream_resume", rid=rid,
+                                 replica=rep.name, emitted=emitted,
+                                 error=repr(last_exc))
 
     def _pick(self, tried, now=None) -> Optional[_Replica]:
         """Power-of-two-choices over routable replicas not yet tried for
@@ -1147,6 +1408,12 @@ class Router:
           (body fields ``slo`` / ``request_id`` / ``deadline_ms`` win).
           429 + ``Retry-After`` when the class was shed, 503 when no
           replica could serve, 504 past deadline.
+        * ``POST /generate`` — ``{"prompt": [ids], "max_new_tokens":
+          opt, "deadline_ms": opt, "slo": opt}`` → NDJSON token stream
+          (one flushed ``{"token": t}`` line per token, final
+          ``{"done": true}``), resumable across replica failures
+          (:meth:`generate`); 429 when shed, 503 when no capable
+          replica.
         * ``POST /swap`` — ``{"prefix":..., "epoch":N}`` rolls the
           zero-downtime hot-swap across all replicas.
         * ``GET /metrics`` — router Prometheus text.
@@ -1196,10 +1463,61 @@ class Router:
                 else:
                     self._reply(404, json.dumps({"error": "not found"}))
 
+            def _generate(self, req):
+                slo = req.get("slo") or \
+                    self.headers.get("X-SLO-Class") or "generate"
+                deadline_ms = req.get("deadline_ms")
+                if deadline_ms is None:
+                    hdr = self.headers.get("X-Deadline-Ms")
+                    if hdr:
+                        deadline_ms = float(hdr)
+                try:
+                    it = router.generate(
+                        req.get("prompt", []), req.get("max_new_tokens"),
+                        slo=slo, deadline_ms=deadline_ms,
+                        request_id=req.get("request_id") or
+                        self.headers.get("X-Request-Id"))
+                except RouterOverloadError as exc:
+                    self._reply(429, json.dumps({"error": str(exc)}),
+                                headers=(("Retry-After",
+                                          "%g" % exc.retry_after),))
+                    return
+                except (ServerClosedError, MXNetError) as exc:
+                    self._reply(503, json.dumps({"error": str(exc)}))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("X-Accel-Buffering", "no")
+                self.end_headers()
+                self.close_connection = True
+                n = 0
+                try:
+                    for tok in it:
+                        self.wfile.write(
+                            (json.dumps({"token": int(tok)}) + "\n")
+                            .encode())
+                        self.wfile.flush()
+                        n += 1
+                    self.wfile.write((json.dumps(
+                        {"done": True, "n": n}) + "\n").encode())
+                    self.wfile.flush()
+                except BrokenPipeError:
+                    it.close()  # client went away: stop the stream
+                except BaseException as exc:
+                    try:
+                        self.wfile.write((json.dumps(
+                            {"error": repr(exc)}) + "\n").encode())
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+
             def do_POST(self):
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     req = json.loads(self.rfile.read(n) or b"{}")
+                    if self.path == "/generate":
+                        self._generate(req)
+                        return
                     if self.path == "/swap":
                         swapped = router.swap(req["prefix"],
                                               int(req["epoch"]))

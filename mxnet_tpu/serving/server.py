@@ -68,7 +68,8 @@ class InferenceServer:
                  ctx=None, buckets: Optional[Sequence[int]] = None,
                  max_wait_us: Optional[int] = None,
                  max_queue: Optional[int] = None,
-                 dtype=np.float32, warmup: bool = True, start: bool = True):
+                 dtype=np.float32, warmup: bool = True, start: bool = True,
+                 generator_spec: Optional[Dict] = None):
         shapes = {k: tuple(v) for k, v in input_shapes.items()}
         batch_dims = {s[0] for s in shapes.values() if len(s) >= 1}
         if len(batch_dims) != 1:
@@ -104,6 +105,17 @@ class InferenceServer:
         self._draining = False
         self._stopped = False
         self._swap_lock = threading.Lock()
+        # generative sidecar: a DecodeEngine sharing this checkpoint's
+        # params, driving POST /generate token streaming
+        self._generator = None
+        self._generator_spec = None
+        self._model_params = params
+        if generator_spec is not None:
+            from ..generation import DecodeEngine
+
+            self.attach_generator(DecodeEngine(
+                params, warmup=warmup, start=start, ctx=self._ctxs[0],
+                dtype=dtype, **generator_spec))
         # warmup=False is an explicit opt-out (lazy compiles): the server
         # counts as warmed-for-readiness the moment it starts
         self._warmed = not warmup
@@ -125,22 +137,59 @@ class InferenceServer:
         deserializing its executable instead of compiling it.  A bundle
         built for a different device topology raises
         :class:`MXNetError` (pass ``attach_aot=False`` to serve without
-        it)."""
+        it).  A bundle whose warmup manifest records a generator spec
+        restores the :class:`~mxnet_tpu.generation.DecodeEngine` too —
+        its prefill/decode executables warm deserialize-only alongside
+        the scoring buckets (pass an explicit ``generator_spec`` to
+        override)."""
         if attach_aot:
             from ..checkpoint import attach_aot_bundle
 
-            attach_aot_bundle(prefix, epoch)
+            manifest = attach_aot_bundle(prefix, epoch)
+            gen_spec = ((manifest or {}).get("warmup") or {}) \
+                .get("generator")
+            if gen_spec and "generator_spec" not in kwargs:
+                kwargs["generator_spec"] = gen_spec
         return cls("%s-symbol.json" % prefix,
                    "%s-%04d.params" % (prefix, epoch),
                    input_shapes, **kwargs)
 
+    def attach_generator(self, engine):
+        """Attach a :class:`~mxnet_tpu.generation.DecodeEngine` (usually
+        built by the ``generator_spec`` ctor kwarg) so this server answers
+        ``POST /generate`` with streamed tokens.  The engine's compiled
+        executables ride along in :meth:`compiled_entries` /
+        :meth:`save_aot_bundle`, its spec in :meth:`swap_config`, and
+        :meth:`swap` rebuilds it on the new params."""
+        self._generator = engine
+        self._generator_spec = engine.spec()
+        return self
+
+    def submit_generate(self, prompt, max_new_tokens=None,
+                        deadline_ms=None):
+        """Queue one generation request; returns its
+        :class:`~mxnet_tpu.generation.GenStream` (iterate for tokens).
+        Raises ``QueueFullError`` on admission rejection (HTTP 429) and
+        :class:`MXNetError` when no generator is attached."""
+        if self._generator is None:
+            raise MXNetError(
+                "no generator attached — construct InferenceServer with "
+                "generator_spec= or call attach_generator()")
+        if self._stopped:
+            raise ServerClosedError("server is stopped")
+        return self._generator.submit(prompt, max_new_tokens,
+                                      deadline_ms=deadline_ms)
+
     def compiled_entries(self):
         """Primed compile-cache wrappers across every replica and bucket
+        — plus the attached generator's prefill/decode executables —
         (empty unless ``MXNET_COMPILE_CACHE_DIR`` is set or a bundle is
         attached)."""
         out = []
         for rep in self._replicas:
             out.extend(rep.compiled_entries())
+        if self._generator is not None:
+            out.extend(self._generator.compiled_entries())
         return out
 
     def save_aot_bundle(self, prefix, epoch):
@@ -163,6 +212,8 @@ class InferenceServer:
             "buckets": list(self.buckets),
             "dtype": self._dtype.name,
         }
+        if self._generator_spec is not None:
+            warmup["generator"] = dict(self._generator_spec)
         return _save(prefix, epoch, entries, warmup=warmup)
 
     # -- lifecycle --------------------------------------------------------
@@ -213,6 +264,8 @@ class InferenceServer:
         if timeout_ms is None:
             timeout_ms = env("MXNET_SERVING_DRAIN_TIMEOUT_MS", 30000.0,
                              float)
+        if self._generator is not None:
+            self._generator.stop(drain=drain, timeout=timeout_ms / 1e3)
         self._batcher.stop(drain=drain, timeout=timeout_ms / 1e3)
 
     def __enter__(self):
@@ -323,8 +376,22 @@ class InferenceServer:
                 for c in self._ctxs]
             for rep in shadows:
                 rep.warmup()
+            shadow_gen = None
+            if self._generator is not None:
+                from ..generation import DecodeEngine
+
+                # warm a shadow engine on the new params before the flip;
+                # in-flight streams finish on the old engine as it drains
+                shadow_gen = DecodeEngine(
+                    params, ctx=self._ctxs[0], dtype=self._dtype,
+                    warmup=True, start=True, **self._generator_spec)
             self._batcher.swap_replicas(shadows)
             self._replicas = shadows
+            if shadow_gen is not None:
+                old_gen, self._generator = self._generator, shadow_gen
+                threading.Thread(
+                    target=old_gen.stop, kwargs={"drain": True},
+                    name="mxtpu-gen-swap-drain", daemon=True).start()
         from .. import telemetry as _tm
 
         _tm.log_event("serving_swap", prefix=prefix, epoch=int(epoch),
@@ -335,7 +402,7 @@ class InferenceServer:
         """Constructor kwargs (minus the model) a router needs to build a
         shadow server of this one — same shapes, buckets, batching knobs,
         contexts, and dtype."""
-        return {
+        cfg = {
             "input_shapes": dict(self._input_shapes),
             "buckets": tuple(self.buckets),
             "max_wait_us": self._batcher.max_wait_us,
@@ -343,12 +410,18 @@ class InferenceServer:
             "ctx": list(self._ctxs),
             "dtype": self._dtype,
         }
+        if self._generator_spec is not None:
+            cfg["generator_spec"] = dict(self._generator_spec)
+        return cfg
 
     def cold_bucket_runs(self) -> int:
         """Post-warmup flushes that hit a never-warmed bucket, summed
         over replicas — the observable recompile counter for the
         "steady state never recompiles" acceptance check."""
-        return sum(rep.cold_runs for rep in self._replicas)
+        n = sum(rep.cold_runs for rep in self._replicas)
+        if self._generator is not None:
+            n += self._generator.cold_decode_runs()
+        return n
 
     def metrics_text(self):
         return self.metrics.render_text()
@@ -363,6 +436,13 @@ class InferenceServer:
           the queue is full (retry with backoff), 504 past deadline.  An
           ``X-Deadline-Ms`` request header sets the deadline too (the
           body field wins when both are present).
+        * ``POST /generate`` — body ``{"prompt": [token ids],
+          "max_new_tokens": optional, "deadline_ms": optional}`` →
+          newline-delimited JSON token stream (``application/x-ndjson``),
+          one ``{"token": t}`` line flushed per decoded token and a final
+          ``{"done": true, ...}`` line; the connection closes to delimit
+          the stream.  429 when generation admission rejects (retry with
+          backoff), 404 when no generator is attached.
         * ``POST /swap`` — body ``{"prefix": ..., "epoch": N}``: in-place
           warm checkpoint hot-swap (every bucket pre-compiled on the new
           params before the atomic flip; serving never pauses).
@@ -414,10 +494,65 @@ class InferenceServer:
                 else:
                     self._reply(404, json.dumps({"error": "not found"}))
 
+            def _generate(self, req):
+                """Stream tokens as NDJSON lines, flushed one per decode
+                step; HTTP/1.0-style connection close delimits the
+                stream (no Content-Length)."""
+                deadline_ms = req.get("deadline_ms")
+                if deadline_ms is None:
+                    hdr = self.headers.get("X-Deadline-Ms")
+                    if hdr:
+                        deadline_ms = float(hdr)
+                try:
+                    stream = server.submit_generate(
+                        req.get("prompt", []),
+                        req.get("max_new_tokens"),
+                        deadline_ms=deadline_ms)
+                except QueueFullError as exc:
+                    self._reply(429, json.dumps({"error": str(exc)}))
+                    return
+                except ServerClosedError as exc:
+                    self._reply(503, json.dumps({"error": str(exc)}))
+                    return
+                except (MXNetError, ValueError, TypeError) as exc:
+                    code = 404 if "no generator attached" in str(exc) \
+                        else 400
+                    self._reply(code, json.dumps({"error": repr(exc)}))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("X-Accel-Buffering", "no")
+                self.end_headers()
+                self.close_connection = True
+                try:
+                    for tok in stream:
+                        self.wfile.write(
+                            (json.dumps({"token": int(tok)}) + "\n")
+                            .encode())
+                        self.wfile.flush()
+                    self.wfile.write((json.dumps(
+                        {"done": True, "n": len(stream.tokens),
+                         "ttft_ms": stream.ttft_ms}) + "\n").encode())
+                    self.wfile.flush()
+                except BrokenPipeError:
+                    pass  # client went away mid-stream
+                except BaseException as exc:
+                    # 200 already sent: signal failure in-band so the
+                    # router can resume the stream on another replica
+                    try:
+                        self.wfile.write((json.dumps(
+                            {"error": repr(exc)}) + "\n").encode())
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+
             def do_POST(self):
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     req = json.loads(self.rfile.read(n) or b"{}")
+                    if self.path == "/generate":
+                        self._generate(req)
+                        return
                     if self.path == "/swap":
                         server.swap(req["prefix"], int(req["epoch"]))
                         self._reply(200, json.dumps(

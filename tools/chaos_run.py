@@ -585,9 +585,166 @@ def run_flash_crowd(seed, timeout=120.0, max_replicas=3, load_threads=6):
     return ok
 
 
+def run_decode_storm(seed, timeout=120.0, replicas=2, load_threads=3,
+                     streams_per_thread=6):
+    """Generative-serving probe, in-process: a Router streams token
+    generations (``Router.generate`` — continuous batching + paged KV on
+    every replica) under open-loop load from ``load_threads`` clients
+    while one replica is hard-killed mid-storm (the seed picks the
+    victim and the kill point).  Streams running on the victim must
+    resume on a survivor by re-prefilling prompt + emitted tokens —
+    greedy decode is deterministic, so every client transcript must be
+    bit-identical to the single-engine reference.  Passes when zero
+    streams failed, every transcript matched, TTFT p99 stayed bounded,
+    and the survivors' decode loops performed zero post-warmup XLA
+    compiles."""
+    import threading
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.serving.metrics import _percentile
+
+    V, layers, heads, hid, S = 64, 2, 2, 32, 32
+    rng = np.random.RandomState(seed)
+    net = mx.models.get_transformer_lm(vocab_size=V, num_layers=layers,
+                                       num_heads=heads, hidden=hid,
+                                       seq_len=S)
+    arg_shapes, _, _ = net.infer_shape(data=(1, S), softmax_label=(1, S))
+    params = {
+        name: mx.nd.array(rng.randn(*shp).astype(np.float32) * 0.05)
+        for name, shp in zip(net.list_arguments(), arg_shapes)
+        if name not in ("data", "softmax_label")}
+    spec = dict(vocab_size=V, num_layers=layers, num_heads=heads,
+                hidden=hid, max_seq_len=S, lane_buckets=(1, 2, 4),
+                page_size=4, num_pages=48, prefill_len_buckets=(8, 16, 32))
+
+    victim_idx = seed % replicas
+    kill_after = 4 + seed % 5  # streams completed before the kill
+    print("chaos_run: decode-storm seed %d: victim r%d dies after %d "
+          "streams, %d replicas x %d clients"
+          % (seed, victim_idx, kill_after, replicas, load_threads),
+          file=sys.stderr, flush=True)
+
+    srvs = [serving.InferenceServer(
+        net, params, {"data": (4, S), "softmax_label": (4, S)},
+        max_wait_us=1000, generator_spec=dict(spec))
+        for _ in range(replicas)]
+    router = serving.Router(srvs, seed=seed, retries=3)
+
+    # greedy decode is deterministic: one reference engine's transcript
+    # is THE correct answer for every (prompt, max_new) the storm sends
+    ref_engine = mx.generation.DecodeEngine(params, **spec)
+    prompts = []
+    for i in range(8):
+        plen = 2 + int(rng.randint(0, 10))
+        prompts.append(([int(t) for t in rng.randint(0, V, size=plen)],
+                        4 + int(rng.randint(0, 8))))
+    reference = {i: ref_engine.generate(p, n)
+                 for i, (p, n) in enumerate(prompts)}
+    ref_engine.stop()
+
+    stop_evt = threading.Event()
+    failures = []
+    mismatches = []
+    ttfts = []
+    completed = [0]
+    lock = threading.Lock()
+
+    def load(tid):
+        i = tid
+        while not stop_evt.is_set():
+            pi = i % len(prompts)
+            prompt, max_new = prompts[pi]
+            try:
+                t0 = time.monotonic()
+                toks = []
+                for tok in router.generate(prompt, max_new,
+                                           request_id="storm-%d-%d"
+                                           % (tid, i)):
+                    if not toks:
+                        with lock:
+                            ttfts.append((time.monotonic() - t0) * 1e3)
+                    toks.append(tok)
+                if toks != reference[pi]:
+                    with lock:
+                        mismatches.append((pi, toks, reference[pi]))
+                with lock:
+                    completed[0] += 1
+            except Exception as exc:
+                with lock:
+                    failures.append(repr(exc))
+            i += load_threads
+
+    deadline = time.monotonic() + timeout
+    ok = True
+    threads = [threading.Thread(target=load, args=(t,), daemon=True)
+               for t in range(load_threads)]
+    try:
+        for t in threads:
+            t.start()
+        while time.monotonic() < deadline and completed[0] < kill_after:
+            time.sleep(0.02)
+        print("chaos_run: killing replica r%d mid-storm (%d streams done)"
+              % (victim_idx, completed[0]), file=sys.stderr, flush=True)
+        srvs[victim_idx].stop(drain=False)
+        target = completed[0] + load_threads * streams_per_thread
+        while time.monotonic() < deadline and completed[0] < target:
+            time.sleep(0.05)
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=max(1.0, deadline - time.monotonic()))
+    finally:
+        stop_evt.set()
+        router.close(stop_backends=True)
+
+    snap = router.metrics.snapshot()
+    if failures:
+        print("chaos_run: %d streams failed (first: %s)"
+              % (len(failures), failures[:3]), file=sys.stderr, flush=True)
+        ok = False
+    if mismatches:
+        pi, got, want = mismatches[0]
+        print("chaos_run: %d transcript mismatches (prompt %d: got %s "
+              "want %s) — the resume duplicated or dropped tokens"
+              % (len(mismatches), pi, got, want),
+              file=sys.stderr, flush=True)
+        ok = False
+    if completed[0] < kill_after + 1:
+        print("chaos_run: storm too short (%d streams) to cover the kill"
+              % completed[0], file=sys.stderr, flush=True)
+        ok = False
+    p99 = _percentile(sorted(ttfts), 0.99) if ttfts else None
+    if p99 is None or p99 > 30000.0:
+        print("chaos_run: TTFT p99 unbounded (%s ms over %d streams)"
+              % (p99, len(ttfts)), file=sys.stderr, flush=True)
+        ok = False
+    cold = sum(s._generator.cold_decode_runs()
+               for i, s in enumerate(srvs) if i != victim_idx)
+    if cold:
+        print("chaos_run: %d post-warmup decode recompiles on survivors"
+              % cold, file=sys.stderr, flush=True)
+        ok = False
+    if ok:
+        print("chaos_run: %d streams completed, 0 failed, 0 mismatches; "
+              "%d mid-stream resumes; TTFT p50/p99 %.1f/%.1f ms; 0 cold "
+              "decode steps"
+              % (completed[0], snap["stream_resumes"],
+                 _percentile(sorted(ttfts), 0.50), p99),
+              file=sys.stderr, flush=True)
+    return ok
+
+
 _SCENARIOS = {"membership-churn": run_membership_churn,
               "serving-failover": run_serving_failover,
-              "flash-crowd": run_flash_crowd}
+              "flash-crowd": run_flash_crowd,
+              "decode-storm": run_decode_storm}
 
 
 def main():

@@ -3,7 +3,10 @@
 Subcommands (all read the cache dir from --dir or the env var):
 
   ls      one line per entry: digest, kind, size, age, compile-ms it
-          saved, and whether it is loadable in THIS environment
+          saved, and whether it is loadable in THIS environment.
+          Kinds: "fwd" (scoring/bucket executors), "gen-prefill" /
+          "gen-step" (DecodeEngine prompt-prefill and per-lane-bucket
+          decode-step executables), "corrupt" (failed verify)
   verify  CRC + header + payload check per entry; exit 1 if any fail
   prune   delete oldest entries until the directory fits the size budget
           (--max-mb or MXNET_COMPILE_CACHE_MAX_MB)
