@@ -246,6 +246,19 @@ def _telemetry_fields(record):
     return record
 
 
+def _autotune_fields(record):
+    """Fold the autotuner counters into the record when tuning is on
+    (never allowed to break the bench): DB hits prove a fleet-shipped
+    tuning DB actually fed this run's configs."""
+    try:
+        from mxnet_tpu import autotune
+        if autotune.enabled():
+            record["autotune"] = autotune.stats()
+    except Exception as e:
+        print("autotune stats failed: %r" % (e,), file=sys.stderr)
+    return record
+
+
 def main(argv=None):
     """Single-process bench (the pre-r5 behavior): ResNet first, then the
     flash kernel + transformer-LM secondaries. Used by tpu_checklist
@@ -274,6 +287,7 @@ def main(argv=None):
     # checklist summarizer scores this shape; only the orchestrated CLI
     # reshapes the headline via _headline()
     _telemetry_fields(record)
+    _autotune_fields(record)
     print(json.dumps(record))
     return record
 
@@ -310,6 +324,7 @@ def _phase(cli):
                     print("flash kernel secondary failed: %r" % (e,),
                           file=sys.stderr)
     _telemetry_fields(record)
+    _autotune_fields(record)
     print(json.dumps(record))
     return record
 

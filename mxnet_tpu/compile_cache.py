@@ -43,7 +43,6 @@ executor stack behaves exactly as before).
 """
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import pickle
@@ -51,6 +50,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from .artifact_store import EntryStore, digest_of
 from .base import MXNetError, env, register_env
 
 __all__ = [
@@ -81,6 +81,10 @@ _MAGIC = b"MXTPUCC1"
 _SCHEMA = 1
 ENTRY_SUFFIX = ".mxc"
 MANIFEST_NAME = "manifest.json"
+
+# on-disk grammar + admin shared with the autotune TuningDB via
+# artifact_store (one implementation, two artifact families)
+_STORE = EntryStore(_MAGIC, ENTRY_SUFFIX, "compile-cache", "compile_cache")
 
 _lock = threading.Lock()
 # process-wide loaded-executable cache: a hot-swap shadow replica in the
@@ -232,64 +236,30 @@ def _signature(args) -> dict:
     return {"tree": str(treedef), "leaves": sig}
 
 
-def _digest(parts: dict) -> str:
-    blob = json.dumps(parts, sort_keys=True, default=str).encode()
-    return hashlib.sha256(blob).hexdigest()[:32]
+_digest = digest_of
 
 
 # ---------------------------------------------------------------------------
 # entry file format:  MAGIC | u64 meta_len | meta json | pickle(payload)
-# with a CRC32 sidecar (filesystem.write_crc_sidecar) over the file
+# with a CRC32 sidecar — the shared artifact_store grammar
 # ---------------------------------------------------------------------------
 
 def _entry_path(d: str, digest: str) -> str:
-    return os.path.join(d, digest + ENTRY_SUFFIX)
+    return _STORE.entry_path(d, digest)
 
 
 def entry_meta(path: str) -> dict:
     """Parse just the json header of an entry (no unpickling)."""
-    with open(path, "rb") as f:
-        magic = f.read(len(_MAGIC))
-        if magic != _MAGIC:
-            raise MXNetError("%s is not a compile-cache entry" % path)
-        mlen = int.from_bytes(f.read(8), "little")
-        if mlen <= 0 or mlen > (1 << 24):
-            raise MXNetError("%s has an implausible meta header" % path)
-        return json.loads(f.read(mlen).decode())
+    return _STORE.entry_meta(path)
 
 
 def _write_entry(d: str, digest: str, meta: dict, payload_bytes: bytes,
                  op: str = "compile_cache.store") -> str:
-    from .filesystem import atomic_write
-
-    os.makedirs(d, exist_ok=True)
-    meta_blob = json.dumps(meta, sort_keys=True, default=str).encode()
-    path = _entry_path(d, digest)
-
-    def writer(f):
-        f.write(_MAGIC)
-        f.write(len(meta_blob).to_bytes(8, "little"))
-        f.write(meta_blob)
-        f.write(payload_bytes)
-
-    # atomic_write fires the fault layer under our dotted op and lands
-    # the CRC sidecar after the data — identical discipline to checkpoints
-    atomic_write(path, writer, checksum=True, op=op)
-    return path
+    return _STORE.write_entry(d, digest, meta, payload_bytes, op=op)
 
 
 def _read_payload(path: str) -> Tuple[dict, bytes]:
-    with open(path, "rb") as f:
-        blob = f.read()
-    if blob[:len(_MAGIC)] != _MAGIC:
-        raise MXNetError("%s is not a compile-cache entry" % path)
-    off = len(_MAGIC)
-    mlen = int.from_bytes(blob[off:off + 8], "little")
-    off += 8
-    if mlen <= 0 or off + mlen > len(blob):
-        raise MXNetError("%s has a torn meta header" % path)
-    meta = json.loads(blob[off:off + mlen].decode())
-    return meta, blob[off + mlen:]
+    return _STORE.read_payload(path)
 
 
 def _env_compatible(meta: dict) -> bool:
@@ -456,6 +426,17 @@ class CachedFunction:
                 (g, str(c)) for g, c in ex._group2ctx.items()),
             "sig": _signature(args),
         }
+        # tuned and untuned executables must never collide: when the
+        # autotuner is active its DB-state fingerprint joins the key (a
+        # different set of winners is a different program)
+        try:
+            from . import autotune as _at
+
+            at_fp = _at.cache_fingerprint()
+        except Exception:
+            at_fp = None
+        if at_fp is not None:
+            parts["autotune"] = at_fp
         if ex._shard_mesh is not None:
             from .sharding.mesh import mesh_fingerprint
 
@@ -535,14 +516,9 @@ class CachedFunction:
 
 
 def _cost_of(compiled) -> Optional[dict]:
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, list):
-            ca = ca[0]
-        return {"flops": ca.get("flops"),
-                "bytes_accessed": ca.get("bytes accessed")}
-    except Exception:
-        return None
+    from .hlo_analysis import cost_analysis
+
+    return cost_analysis(compiled)
 
 
 def maybe_cached(fn, kind: str, static_key, executor):
@@ -604,6 +580,17 @@ def save_bundle(path: str, entries, warmup: Optional[dict] = None) -> str:
                 "mesh_axes": meta.get("mesh_axes"),
                 "cost": meta.get("cost"),
             })
+    # the tuning DB rides along: a restored replica is tuned-by-
+    # construction, with zero re-tuning (best-effort — a bundle without
+    # tuning entries is still a valid bundle)
+    try:
+        from . import autotune as _at
+
+        n = _at.export_to_bundle(path)
+        if n:
+            manifest["autotune_entries"] = n
+    except Exception:
+        pass
     atomic_write(os.path.join(path, MANIFEST_NAME),
                  lambda f: f.write(json.dumps(manifest, indent=1,
                                               default=str).encode()),
@@ -650,6 +637,12 @@ def attach_bundle(path: str, mesh=None) -> dict:
     with _lock:
         if path not in _bundles:
             _bundles.append(path)
+    try:
+        from . import autotune as _at
+
+        _at.attach_bundle_overlay(path)
+    except Exception:
+        pass
     _log_event("compile_cache_bundle_attached", path=path,
                entries=len(manifest.get("entries", [])))
     return manifest
@@ -667,62 +660,27 @@ def detach_bundles() -> None:
 def ls_entries(d: str) -> List[dict]:
     """[{digest, path, bytes, mtime, kind, compile_ms, env_ok}] for every
     entry in ``d`` (unreadable headers report kind='corrupt')."""
-    out = []
-    if not os.path.isdir(d):
-        return out
-    for name in sorted(os.listdir(d)):
-        if not name.endswith(ENTRY_SUFFIX):
-            continue
-        path = os.path.join(d, name)
-        st = os.stat(path)
-        rec = {"digest": name[:-len(ENTRY_SUFFIX)], "path": path,
-               "bytes": st.st_size, "mtime": st.st_mtime}
-        try:
-            meta = entry_meta(path)
-            rec.update(kind=meta.get("kind"),
-                       compile_ms=meta.get("compile_ms"),
-                       env_ok=_env_compatible(meta))
-        except Exception as exc:
-            rec.update(kind="corrupt", error=repr(exc)[:120])
-        out.append(rec)
-    return out
+    return _STORE.ls_entries(
+        d, meta_fields=lambda meta: {"kind": meta.get("kind"),
+                                     "compile_ms": meta.get("compile_ms"),
+                                     "env_ok": _env_compatible(meta)})
 
 
 def verify_entry(path: str) -> Tuple[bool, str]:
     """(ok, detail): CRC sidecar + header + payload unpickle check —
     everything short of loading onto devices."""
-    from .filesystem import verify_crc_sidecar
-
-    crc = verify_crc_sidecar(path)
-    if crc is False:
-        return False, "crc mismatch"
-    try:
-        meta, payload = _read_payload(path)
-        pickle.loads(payload)
-    except Exception as exc:
-        return False, "unreadable: %r" % (exc,)
-    if not _env_compatible(meta):
-        return True, "ok (stale env: recompiles on load)"
-    return True, "ok"
+    ok, detail = _STORE.verify_entry(
+        path, payload_check=lambda meta, payload: pickle.loads(payload),
+        env_ok=_env_compatible)
+    if detail == "ok (stale env: invalidates on load)":
+        detail = "ok (stale env: recompiles on load)"
+    return ok, detail
 
 
 def prune(d: str, budget_mb: int) -> List[str]:
     """Delete oldest-mtime entries (and their sidecars) until the
     directory is under ``budget_mb``.  Returns the removed paths."""
-    entries = ls_entries(d)
-    total = sum(e["bytes"] for e in entries)
-    budget = budget_mb * (1 << 20)
-    removed = []
-    for e in sorted(entries, key=lambda e: e["mtime"]):
-        if total <= budget:
-            break
-        for p in (e["path"], e["path"] + ".crc32"):
-            try:
-                os.unlink(p)
-            except OSError:
-                pass
-        removed.append(e["path"])
-        total -= e["bytes"]
+    removed = _STORE.prune(d, budget_mb)
     if removed:
         _log_event("compile_cache_pruned", dir=d, removed=len(removed))
     return removed

@@ -412,8 +412,44 @@ class Executor:
         out_s = (None, a, d, s)
         return in_s, out_s
 
+    def _autotune_fused(self, stable_key, abstract_args, make_jit,
+                        donate_allowed, env_remat):
+        """Tuned {remat, donate} for this fused program, or None.  The
+        record-mode loop lowers each remat x donation variant of the
+        EXACT program about to run (same graph, same abstract args) and
+        scores by the XLA-cost-analysis roofline.  Any failure degrades
+        to the env-derived defaults."""
+        if abstract_args is None:
+            return None
+        try:
+            from . import autotune
+
+            if not autotune.enabled():
+                return None
+            import jax
+
+            sig = jax.tree_util.tree_map(
+                lambda x: (tuple(x.shape), str(x.dtype)), abstract_args)
+            key = {"graph": self._plan.fingerprint(),
+                   "static": repr(stable_key),
+                   "compute_dtype": str(self._compute_dtype),
+                   "sig": repr(sig),
+                   "remat_env": int(env_remat),
+                   "donate_allowed": bool(donate_allowed)}
+
+            def build(cand):
+                return (make_jit(bool(cand["remat"]),
+                                 bool(cand["donate"])), abstract_args)
+
+            return autotune.get_or_tune(
+                "fused_step", key,
+                candidates=autotune.spaces.fused_step(donate_allowed),
+                build_fn=build, default=None)
+        except Exception:
+            return None
+
     def _get_fused_step(self, key, update_infos, pure_update, needs_rng,
-                        shardings=None, stable_key=None):
+                        shardings=None, stable_key=None, abstract_args=None):
         """Jitted forward+backward+update with donated param/state/aux
         buffers.  This is the whole of the reference's per-batch engine
         traffic (GraphExecutor::Forward/Backward + the kvstore push/pull +
@@ -427,42 +463,46 @@ class Executor:
         if key not in self._jit_cache:
             plan = self._plan
             placement = self._placement
-            remat = bool(env("MXNET_BACKWARD_DO_MIRROR", 0, int))
+            env_remat = bool(env("MXNET_BACKWARD_DO_MIRROR", 0, int))
             cast = self._cast_fn()
 
-            def fn(diff_args, states, aux, other_args, rng, sc, opt_rng):
-                lr0, wd0, t = sc
+            def make_fn(remat):
+                def fn(diff_args, states, aux, other_args, rng, sc, opt_rng):
+                    lr0, wd0, t = sc
 
-                def f(d):
-                    merged = dict(other_args)
-                    merged.update(d)
-                    outs, new_aux = plan.run(cast(merged), aux, rng, True,
-                                             placement=placement)
-                    return tuple(outs), new_aux
+                    def f(d):
+                        merged = dict(other_args)
+                        merged.update(d)
+                        outs, new_aux = plan.run(cast(merged), aux, rng,
+                                                 True, placement=placement)
+                        return tuple(outs), new_aux
 
-                f2 = jax.checkpoint(f) if remat else f
-                primals, vjp_fn = jax.vjp(f2, diff_args)
-                outs, new_aux = primals
-                cts = tuple(jnp.ones_like(o) for o in outs)
-                (grads,) = vjp_fn((cts, jax.tree_util.tree_map(
-                    jnp.zeros_like, new_aux)))
-                keys = {}
-                if needs_rng and opt_rng is not None:
-                    subkeys = jax.random.split(opt_rng, len(update_infos))
-                    keys = {name: subkeys[i]
-                            for i, (name, _, _, _) in enumerate(update_infos)}
-                new_params = {}
-                new_states = {}
-                for name, _idx, lmult, wmult in update_infos:
-                    w, s = pure_update(
-                        diff_args[name], grads[name], states[name],
-                        lr0 * lmult, wd0 * wmult, t, keys.get(name))
-                    new_params[name] = w
-                    new_states[name] = s
-                return list(outs), new_aux, new_params, new_states
+                    f2 = jax.checkpoint(f) if remat else f
+                    primals, vjp_fn = jax.vjp(f2, diff_args)
+                    outs, new_aux = primals
+                    cts = tuple(jnp.ones_like(o) for o in outs)
+                    (grads,) = vjp_fn((cts, jax.tree_util.tree_map(
+                        jnp.zeros_like, new_aux)))
+                    keys = {}
+                    if needs_rng and opt_rng is not None:
+                        subkeys = jax.random.split(opt_rng, len(update_infos))
+                        keys = {name: subkeys[i]
+                                for i, (name, _, _, _)
+                                in enumerate(update_infos)}
+                    new_params = {}
+                    new_states = {}
+                    for name, _idx, lmult, wmult in update_infos:
+                        w, s = pure_update(
+                            diff_args[name], grads[name], states[name],
+                            lr0 * lmult, wd0 * wmult, t, keys.get(name))
+                        new_params[name] = w
+                        new_states[name] = s
+                    return list(outs), new_aux, new_params, new_states
+
+                return fn
 
             if self._naive:
-                self._jit_cache[key] = fn
+                self._jit_cache[key] = make_fn(env_remat)
             else:
                 from . import compile_cache as _cc
 
@@ -472,20 +512,37 @@ class Executor:
                 # an entry compiled here must stay correct when another
                 # process deserializes it.  The default (cache off) keeps
                 # in-place buffer reuse.
-                donate = () if _cc.active() else (0, 1, 2)
-                if shardings is not None:
-                    jfn = jax.jit(
-                        fn, donate_argnums=donate,
-                        in_shardings=shardings[0],
-                        out_shardings=shardings[1])
-                else:
-                    jfn = jax.jit(fn, donate_argnums=donate)
+                donate_allowed = not _cc.active()
+
+                def make_jit(remat, donate_on):
+                    donate = (0, 1, 2) if (donate_on and donate_allowed) \
+                        else ()
+                    fn = make_fn(remat)
+                    if shardings is not None:
+                        return jax.jit(fn, donate_argnums=donate,
+                                       in_shardings=shardings[0],
+                                       out_shardings=shardings[1])
+                    return jax.jit(fn, donate_argnums=donate)
+
+                remat, donate_on = env_remat, donate_allowed
+                tuned = self._autotune_fused(stable_key, abstract_args,
+                                             make_jit, donate_allowed,
+                                             env_remat)
+                if tuned is not None:
+                    remat = bool(tuned.get("remat", remat))
+                    donate_on = (bool(tuned.get("donate", donate_on))
+                                 and donate_allowed)
+                    self._fused_autotune = dict(tuned)
+                jfn = make_jit(remat, donate_on)
                 # the persistent key uses stable_key (no object ids) so a
                 # fresh process — or a fresh optimizer instance with the
-                # same hypers — maps to the same disk entry; donation
-                # changes the compiled program, so it is part of the key
+                # same hypers — maps to the same disk entry; donation and
+                # remat change the compiled program, so they are part of
+                # the key
+                donate = (0, 1, 2) if (donate_on and donate_allowed) else ()
                 if stable_key is not None:
-                    stable_key = stable_key + (("donate", tuple(donate)),)
+                    stable_key = stable_key + (("donate", tuple(donate)),
+                                               ("remat", int(remat)))
                 self._jit_cache[key] = _cc.maybe_cached(
                     jfn, "fused", stable_key, self)
         return self._jit_cache[key]
@@ -573,21 +630,27 @@ class Executor:
                       bool(optimizer.needs_rng))
         first_build = key not in self._jit_cache
         shardings = None
-        if self._shard_mesh is not None and not self._naive and first_build:
-            shardings = self._fused_shardings(diff_args, states, aux,
-                                              other_args)
+        abstract_args = None
+        if first_build and not self._naive:
+            if self._shard_mesh is not None:
+                shardings = self._fused_shardings(diff_args, states, aux,
+                                                  other_args)
+            # abstract arg signature of the fused call: the autotuner
+            # lowers candidate variants against it, and perf_probe reuses
+            # it (via _fused_introspect) to lower the exact same program
+            abstract_args = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                (diff_args, states, aux, other_args, rng, sc, opt_rng))
         fn = self._get_fused_step(key, tuple(infos), optimizer.pure_update,
                                   optimizer.needs_rng, shardings,
-                                  stable_key=stable_key)
+                                  stable_key=stable_key,
+                                  abstract_args=abstract_args)
         if first_build and not self._naive:
             # introspection hook (compile-miss path only — zero per-step
-            # cost): abstract arg signature of the fused call, so
-            # tools/perf_probe.py can lower/compile the exact same program
-            # and read XLA cost analysis / HLO without re-deriving the
-            # arg packing
-            self._fused_introspect = (fn, jax.tree_util.tree_map(
-                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-                (diff_args, states, aux, other_args, rng, sc, opt_rng)))
+            # cost), so tools/perf_probe.py can lower/compile the exact
+            # same program and read XLA cost analysis / HLO without
+            # re-deriving the arg packing
+            self._fused_introspect = (fn, abstract_args)
             # consumed by telemetry.StepMonitor (Module.update): one XLA
             # cost analysis per new executable, never per step
             self._fused_new_compile = True

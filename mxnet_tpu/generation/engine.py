@@ -51,6 +51,43 @@ from .kv_pool import KVPoolExhaustedError, PagedKVPool
 
 __all__ = ["DecodeEngine", "GenStream"]
 
+
+def _autotune_engine_config(num_layers, num_heads, head_dim, max_seq_len,
+                            dtype, max_lanes):
+    """Tuned {lane_buckets, page_size} for this model geometry, or None.
+
+    The objective is analytic and deterministic — no lowering: expected
+    padded-lane waste under uniform live-lane demand, KV fragmentation
+    of a half page per sequence, a per-bucket compile-cost term (every
+    lane bucket is one more decode executable to build and keep warm)
+    and a page-table-length term penalizing tiny pages."""
+    try:
+        from .. import autotune
+    except Exception:
+        return None
+    if not autotune.enabled():
+        return None
+    key = {"num_layers": int(num_layers), "num_heads": int(num_heads),
+           "head_dim": int(head_dim), "max_seq_len": int(max_seq_len),
+           "max_lanes": int(max_lanes), "dtype": str(np.dtype(dtype))}
+
+    def score(cand):
+        buckets = sorted(int(b) for b in cand["lane_buckets"])
+        page = int(cand["page_size"])
+        waste = 0.0
+        for n in range(1, max_lanes + 1):
+            b = next((b for b in buckets if b >= n), buckets[-1])
+            waste += (b - n) / float(b)
+        waste /= max_lanes
+        frag = (page - 1) / 2.0 / max(1.0, max_seq_len / 2.0)
+        return (waste + frag + 0.02 * len(buckets)
+                + 0.0005 * (max_seq_len / float(page)))
+
+    return autotune.get_or_tune(
+        "decode_engine", key,
+        candidates=autotune.spaces.decode_engine(max_lanes, max_seq_len),
+        score_fn=score, default=None)
+
 register_env("MXNET_GEN_PAGE_SIZE", 16, int,
              "KV-pool page size (tokens per page) for DecodeEngine.")
 register_env("MXNET_GEN_NUM_PAGES", 128, int,
@@ -227,6 +264,21 @@ class DecodeEngine:
         self.eos_id = eos_id
         self._ctx = ctx
         self._dtype = np.dtype(dtype)
+        # unset knobs consult the autotuner before the env defaults:
+        # explicit constructor args always pin, tuned winners beat the
+        # built-in defaults, env vars remain the no-autotune fallback
+        tuned = None
+        if page_size is None or lane_buckets is None:
+            tuned = _autotune_engine_config(
+                self.num_layers, self.num_heads, self.head_dim,
+                self.max_seq_len, self._dtype,
+                max_lanes=(max(int(b) for b in lane_buckets)
+                           if lane_buckets is not None
+                           else env("MXNET_GEN_MAX_LANES", 8, int)))
+        if page_size is None and tuned:
+            page_size = tuned.get("page_size")
+        if lane_buckets is None and tuned:
+            lane_buckets = tuned.get("lane_buckets")
         self.page_size = int(env("MXNET_GEN_PAGE_SIZE", 16, int)
                              if page_size is None else page_size)
         self.num_pages = int(env("MXNET_GEN_NUM_PAGES", 128, int)

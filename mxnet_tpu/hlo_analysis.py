@@ -1,0 +1,139 @@
+"""Shared XLA cost-analysis and HLO-audit helpers.
+
+One home for the flops/bytes-accessed introspection that used to be
+copy-pasted across ``telemetry.step_monitor``, ``compile_cache``,
+``tools/perf_probe.py`` and ``tools/layout_probe.py`` — and that the
+autotuner now uses as its cheap objective: lower a candidate program,
+read XLA's own cost analysis, and score it with a roofline model
+("A Learned Performance Model for TPUs", arxiv 2008.01040, argues the
+compiled program's numbers are the ones that matter).  Everything here
+runs on CPU with no chip — lowering is shape-only.
+"""
+from __future__ import annotations
+
+import collections
+import re
+from typing import Optional
+
+from .base import env, register_env
+
+__all__ = ["peak_flops", "hbm_bytes_per_s", "cost_analysis",
+           "lower_and_analyze", "roofline_ms", "hlo_op_counts",
+           "bn_fusion_analysis"]
+
+register_env("MXNET_TELEMETRY_HBM_GBS", 0.0, float,
+             "HBM bandwidth (GB/s) for the roofline bytes term; "
+             "0 uses the TPU v5e figure (819 GB/s).")
+
+# TPU v5e: 197 bf16 TFLOP/s, 819 GB/s HBM — the chip every PERF.md
+# number was measured on; both overridable for other parts
+_V5E_PEAK_FLOPS = 197e12
+_V5E_HBM_BYTES_S = 819e9
+
+
+def peak_flops() -> float:
+    """MFU denominator: MXNET_TELEMETRY_PEAK_FLOPS override, else the
+    TPU v5e bf16 peak used by bench.py/perf_probe (197 TFLOP/s)."""
+    v = env("MXNET_TELEMETRY_PEAK_FLOPS", 0.0, float)
+    return float(v) if v else _V5E_PEAK_FLOPS
+
+
+def hbm_bytes_per_s() -> float:
+    """Roofline bytes denominator: MXNET_TELEMETRY_HBM_GBS override,
+    else TPU v5e HBM bandwidth (819 GB/s)."""
+    v = env("MXNET_TELEMETRY_HBM_GBS", 0.0, float)
+    return float(v) * 1e9 if v else _V5E_HBM_BYTES_S
+
+
+def cost_analysis(compiled) -> Optional[dict]:
+    """XLA's cost analysis of a compiled executable as
+    ``{"flops", "bytes_accessed"}``, or None when the backend doesn't
+    report one."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return {"flops": ca.get("flops"),
+                "bytes_accessed": ca.get("bytes accessed")}
+    except Exception:
+        return None
+
+
+def lower_and_analyze(fn, abstract):
+    """Lower+compile a jitted program at abstract args and read XLA cost
+    analysis.  Returns (compiled, {"flops", "bytes_accessed"}); compiled
+    is None when the program can't be lowered (naive engine)."""
+    if fn is None or not hasattr(fn, "lower"):
+        return None, None
+    lowered = fn.lower(*abstract)
+    compiled = lowered.compile()
+    return compiled, cost_analysis(compiled)
+
+
+def roofline_ms(info) -> Optional[float]:
+    """Roofline lower-bound runtime (ms) of a cost-analysis dict: the
+    slower of the compute term (flops/peak) and the memory term
+    (bytes/HBM-bandwidth).  The autotuner's CPU-side objective — exact
+    runtimes are wrong off-chip, but the RANKING across candidates of
+    the same program tracks the roofline."""
+    if not info:
+        return None
+    flops = float(info.get("flops") or 0.0)
+    nbytes = float(info.get("bytes_accessed") or 0.0)
+    if flops <= 0 and nbytes <= 0:
+        return None
+    return max(flops / peak_flops(), nbytes / hbm_bytes_per_s()) * 1e3
+
+
+def hlo_op_counts(hlo_text, interesting=None) -> dict:
+    """Histogram of HLO opcodes in a compiled ``as_text()`` dump,
+    optionally filtered to an opcode whitelist."""
+    ops = collections.Counter(
+        re.findall(r"^\s*[%\w.-]+ = [\w\[\]<>{}, ]*?(\w+)\(", hlo_text,
+                   re.M))
+    if interesting is None:
+        return dict(ops)
+    return {k: v for k, v in ops.most_common() if k in interesting}
+
+
+def bn_fusion_analysis(hlo_text) -> dict:
+    """Does BN's scale/shift ride the conv epilogue? (VERDICT r4 ask.)
+
+    Classifies every convolution by actual dataflow, not substring
+    presence: a conv counts as epilogue-fused only when its RESULT name
+    is an operand of a multiply/add/subtract inside the same non-entry
+    fusion computation (the BN affine transform then costs no extra HBM
+    round trip). Convs in the ENTRY computation are bare by definition —
+    entry-level instructions are separate kernels even when an
+    elementwise op consumes them there (worth ~2 MFU points per PERF.md's
+    control-minus-BN-stats data if that is where BN's scale/shift run)."""
+    # computations: optional ENTRY prefix, then 'name (...) -> ... {'.
+    # The '%' name sigil is optional THROUGHOUT: modern compiled.as_text()
+    # dumps omit it ('convolution.3 = f32[...] convolution(arg.1, ...)'),
+    # classic dumps keep it — names are normalized sigil-less.
+    blocks = re.findall(r"^(ENTRY\s+)?%?[\w.-]+ [^\n]*\{\n(.*?)^\s*\}",
+                        hlo_text, re.M | re.S)
+    fused = fused_plain = bare = 0
+    for entry_prefix, body in blocks:
+        conv_names = [m.group(1).lstrip("%") for m in re.finditer(
+            r"(%?[\w.-]+)\s*=\s*\S+\s+convolution\(", body)]
+        if not conv_names:
+            continue
+        if entry_prefix:
+            bare += len(conv_names)
+            continue
+        ew_operands = set()
+        for m in re.finditer(
+                r"=\s*\S+\s+(?:multiply|add|subtract)\(([^)]*)\)", body):
+            ew_operands.update(
+                t.lstrip("%")
+                for t in re.findall(r"%?[\w][\w.-]*", m.group(1)))
+        for c in conv_names:
+            if c in ew_operands:
+                fused += 1
+            else:
+                fused_plain += 1
+    return {"convs_total": fused + fused_plain + bare,
+            "convs_fused_with_elementwise_epilogue": fused,
+            "convs_fused_plain": fused_plain,
+            "convs_bare_in_entry": bare}

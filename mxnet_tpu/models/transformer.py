@@ -20,7 +20,7 @@ def _dense(x, n_in, n_out, name):
     return h
 
 
-def _block(x, hidden, num_heads, seq_len, name, block_q=512, block_k=512,
+def _block(x, hidden, num_heads, seq_len, name, block_q=None, block_k=None,
            attn_impl="flash"):
     head_dim = hidden // num_heads
     # attention sublayer (pre-norm)
@@ -55,7 +55,7 @@ def _block(x, hidden, num_heads, seq_len, name, block_q=512, block_k=512,
 
 
 def get_transformer_lm(vocab_size=32000, num_layers=4, num_heads=8,
-                       hidden=512, seq_len=128, block_q=512, block_k=512,
+                       hidden=512, seq_len=128, block_q=None, block_k=None,
                        attn_impl="flash"):
     """Causal LM: data (b, seq_len) token ids -> SoftmaxOutput over the
     vocab at every position (label (b*seq_len,) next-token ids).

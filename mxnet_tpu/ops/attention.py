@@ -408,18 +408,99 @@ def _flash_backward(q, k, v, o, lse, do, causal, scale, block_q, block_k,
 # ---------------------------------------------------------------------------
 
 
+_DEFAULT_BLOCK = 512
+
+
+def _autotune_blocks(seq_q, seq_k, head_dim, dtype, causal):
+    """Tuning-DB winner for this shape family, or None.  The record-mode
+    tuning loop lowers the forward kernel per candidate at one head /
+    batch 1 (the grid scales linearly in b*h, so the per-candidate
+    RANKING is shape-family-wide) and scores by the XLA-cost-analysis
+    roofline — CPU-runnable, no chip needed."""
+    from .. import autotune
+
+    if not autotune.enabled():
+        return None
+    key = {"seq_q": int(seq_q), "seq_k": int(seq_k),
+           "head_dim": int(head_dim), "dtype": str(dtype),
+           "causal": bool(causal)}
+
+    def build(cand):
+        import jax
+
+        interpret = jax.default_backend() != "tpu"
+        scale = 1.0 / np.sqrt(head_dim)
+
+        def fwd(q, k, v):
+            return _flash_forward(q, k, v, causal, scale,
+                                  cand["block_q"], cand["block_k"],
+                                  interpret)[0]
+
+        sds = jax.ShapeDtypeStruct
+        abstract = (sds((1, seq_q, 1, head_dim), dtype),
+                    sds((1, seq_k, 1, head_dim), dtype),
+                    sds((1, seq_k, 1, head_dim), dtype))
+        return jax.jit(fwd), abstract
+
+    def measure(cand):
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        fn, abstract = build(cand)
+        args = [jnp.zeros(a.shape, a.dtype) for a in abstract]
+        compiled = fn.lower(*args).compile()
+        jax.block_until_ready(compiled(*args))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = compiled(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / 3 * 1e3
+
+    return autotune.get_or_tune(
+        "flash_attention", key,
+        candidates=autotune.spaces.flash_blocks(seq_q, seq_k),
+        build_fn=build, measure_fn=measure, default=None)
+
+
+def resolve_blocks(block_q, block_k, seq_q, seq_k, head_dim=128,
+                   dtype="bfloat16", causal=False):
+    """The EFFECTIVE (block_q, block_k) a call runs with: explicit ints
+    are respected as-is, None consults the autotuner (winner for this
+    shape family when enabled) and falls back to the measured default
+    (512/512 — PERF.md's v5e-validated config); either way the result
+    is clamped by ``_pick_block``."""
+    if block_q is None or block_k is None:
+        tuned = None
+        try:
+            tuned = _autotune_blocks(seq_q, seq_k, head_dim, dtype, causal)
+        except Exception:
+            tuned = None
+        if block_q is None:
+            block_q = (tuned or {}).get("block_q", _DEFAULT_BLOCK)
+        if block_k is None:
+            block_k = (tuned or {}).get("block_k", _DEFAULT_BLOCK)
+    return _pick_block(int(block_q), seq_q), _pick_block(int(block_k), seq_k)
+
+
 def flash_attention(q, k, v, causal: bool = False, scale=None,
-                    block_q: int = 512, block_k: int = 512):
+                    block_q=None, block_k=None):
     """Exact fused attention, Pallas fwd+bwd. q, k, v: [b, seq, heads, d].
 
     Default 512 blocks: measured on v5e (d=128, s=8k), 512-wide tiles run
     ~3x faster than 128 (the MXU is fed longer contractions and the VPU
     softmax amortizes); blocks are clamped to the sequence length for
-    short inputs."""
+    short inputs.  Passing None (the default) consults the autotuner
+    (``MXNET_AUTOTUNE``) for this shape family's winner before falling
+    back to 512; explicit block sizes are always respected."""
     import jax
 
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    block_q, block_k = resolve_blocks(block_q, block_k, q.shape[1],
+                                      k.shape[1], q.shape[-1], q.dtype,
+                                      causal)
     interpret = jax.default_backend() != "tpu"
 
     @jax.custom_vjp
@@ -447,18 +528,25 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
 # ---------------------------------------------------------------------------
 
 
-def _attrs_config(attrs, d):
+def _attrs_config(attrs, q, k):
+    """(causal, scale, block_q, block_k) for the registered op.  Attrs
+    without pinned block sizes resolve through the autotuner (falling
+    back to the measured 512 default) — the fwd and bwd kernels see the
+    same deterministic resolution for one (attrs, shapes) pair."""
+    d = q.shape[-1]
     scale = attrs.get("scale")
     if scale is None:
         scale = 1.0 / np.sqrt(d)
-    return (bool(attrs.get("causal", False)), float(scale),
-            int(attrs.get("block_q", 128)), int(attrs.get("block_k", 128)))
+    causal = bool(attrs.get("causal", False))
+    bq, bk = resolve_blocks(attrs.get("block_q"), attrs.get("block_k"),
+                            q.shape[1], k.shape[1], d, q.dtype, causal)
+    return causal, float(scale), bq, bk
 
 
 def _fa_fn(attrs, query, key, value):
     import jax
 
-    causal, scale, bq, bk = _attrs_config(attrs, query.shape[-1])
+    causal, scale, bq, bk = _attrs_config(attrs, query, key)
     interpret = jax.default_backend() != "tpu"
     o, _ = _flash_forward(query, key, value, causal, scale, bq, bk,
                           interpret)
@@ -468,7 +556,7 @@ def _fa_fn(attrs, query, key, value):
 def _fa_fwd(attrs, query, key, value):
     import jax
 
-    causal, scale, bq, bk = _attrs_config(attrs, query.shape[-1])
+    causal, scale, bq, bk = _attrs_config(attrs, query, key)
     interpret = jax.default_backend() != "tpu"
     o, lse = _flash_forward(query, key, value, causal, scale, bq, bk,
                             interpret)
@@ -479,7 +567,7 @@ def _fa_bwd(attrs, res, ct):
     import jax
 
     q, k, v, o, lse = res
-    causal, scale, bq, bk = _attrs_config(attrs, q.shape[-1])
+    causal, scale, bq, bk = _attrs_config(attrs, q, k)
     interpret = jax.default_backend() != "tpu"
     return _flash_backward(q, k, v, o, lse, ct, causal, scale, bq, bk,
                            interpret)
@@ -540,8 +628,9 @@ def _register():
         inputs=("query", "key", "value"),
         params={"causal": Param(bool, False),
                 "scale": Param("float-or-none", None),
-                "block_q": Param(int, 512),
-                "block_k": Param(int, 512)},
+                # None = autotuner winner, else the measured 512 default
+                "block_q": Param("int-or-none", None),
+                "block_k": Param("int-or-none", None)},
         infer_shape=lambda attrs, s: (s, [s[0]], []),
         hint="flashattention")
 

@@ -231,7 +231,8 @@ def _reset_for_tests() -> None:
     # would keep writing to the dropped registry
     for modname, attr in (("mxnet_tpu.io", "_PREFETCH_TELEM"),
                           ("mxnet_tpu.kvstore_server", "_TELEM"),
-                          ("mxnet_tpu.compile_cache", "_instruments")):
+                          ("mxnet_tpu.compile_cache", "_instruments"),
+                          ("mxnet_tpu.autotune", "_instruments")):
         m = sys.modules.get(modname)
         if m is not None:
             setattr(m, attr, None)

@@ -23,6 +23,7 @@ import warnings
 from typing import Optional
 
 from ..base import env
+from ..hlo_analysis import lower_and_analyze, peak_flops
 
 __all__ = ["StepMonitor", "RecompileWarning", "peak_flops",
            "lower_and_analyze", "fused_cost_analysis"]
@@ -30,33 +31,6 @@ __all__ = ["StepMonitor", "RecompileWarning", "peak_flops",
 
 class RecompileWarning(UserWarning):
     """The fused train step recompiled after warmup (shape change)."""
-
-
-def peak_flops() -> float:
-    """MFU denominator: MXNET_TELEMETRY_PEAK_FLOPS override, else the
-    TPU v5e bf16 peak used by bench.py/perf_probe (197 TFLOP/s)."""
-    v = env("MXNET_TELEMETRY_PEAK_FLOPS", 0.0, float)
-    return float(v) if v else 197e12
-
-
-def lower_and_analyze(fn, abstract):
-    """Lower+compile the introspected fused program and read XLA cost
-    analysis.  Returns (compiled, {"flops", "bytes_accessed"}); compiled
-    is None when the program can't be lowered (naive engine)."""
-    if fn is None or not hasattr(fn, "lower"):
-        return None, None
-    lowered = fn.lower(*abstract)
-    compiled = lowered.compile()
-    info = None
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, list):
-            ca = ca[0]
-        info = {"flops": ca.get("flops"),
-                "bytes_accessed": ca.get("bytes accessed")}
-    except Exception:
-        pass
-    return compiled, info
 
 
 def fused_cost_analysis(executor):

@@ -1,0 +1,32 @@
+"""Subprocess side of the cross-process autotune acceptance test.
+
+Resolves the flash-attention blocks for the benched shape family
+(seq 512, head_dim 128, f32, causal) with blocks UNPINNED, then prints
+one JSON line with the effective blocks, the autotune counters, and the
+compile-cache key fingerprint.  The parent process drives it twice
+against one MXNET_AUTOTUNE_DIR: first in record mode (pays the tuning
+cost), then in a fresh process in lookup mode (must inherit the winner
+with ZERO re-tuning — the once-per-fleet contract).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from mxnet_tpu import autotune
+    from mxnet_tpu.ops.attention import resolve_blocks
+
+    bq, bk = resolve_blocks(None, None, 512, 512, head_dim=128,
+                            dtype=np.dtype("float32"), causal=True)
+    print(json.dumps({"blocks": [bq, bk], "stats": autotune.stats(),
+                      "fingerprint": autotune.cache_fingerprint()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

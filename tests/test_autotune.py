@@ -1,0 +1,442 @@
+"""Persistent-autotuner tests — the PR-13 acceptance criteria as
+assertions.
+
+Search-space enumeration is deterministic and clamp-stable, the Tuner
+picks one reproducible winner (ties break on canonical JSON), the
+TuningDB round-trips through a fresh instance, tuned and untuned
+executables never share a compile-cache digest, AOT bundles carry the
+tuning entries, and — the headline — a fresh process in lookup mode
+inherits a record-mode winner with ZERO re-tuning.  Chaos-marked:
+corrupt/torn tuning entries degrade to the built-in default config
+with a structured telemetry event, never a crash.
+"""
+import importlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autotune, compile_cache as cc, faults, telemetry
+from mxnet_tpu.autotune import spaces
+from mxnet_tpu.ops.attention import resolve_blocks
+
+atdb = importlib.import_module("mxnet_tpu.autotune.db")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "autotune_worker.py")
+ADMIN = os.path.join(ROOT, "tools", "autotune_admin.py")
+
+IN_DIM = 6
+HID = 3
+
+
+def _reset():
+    telemetry._reset_for_tests()
+    autotune.reset_for_tests()
+    cc.reset_stats()
+
+
+@pytest.fixture
+def tune_dir(tmp_path, monkeypatch):
+    """Fresh tuning-DB dir in record mode, clean counters both sides."""
+    d = str(tmp_path / "at")
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", d)
+    monkeypatch.setenv("MXNET_AUTOTUNE", "record")
+    _reset()
+    yield d
+    _reset()
+
+
+def _put(site, key, config, d=None):
+    db = autotune.db() if d is None else atdb.TuningDB(d)
+    db.put(site, key, config, {"objective": "test"})
+    return db
+
+
+# ---------------------------------------------------------------------------
+# search spaces
+# ---------------------------------------------------------------------------
+
+def test_flash_space_dedup_and_clamp_stability():
+    cands = spaces.flash_blocks(512, 512)
+    pairs = [(c["block_q"], c["block_k"]) for c in cands]
+    assert len(pairs) == len(set(pairs)), "duplicate effective configs"
+    assert (512, 512) in pairs and (128, 512) in pairs
+    from mxnet_tpu.ops.attention import _pick_block
+    for bq, bk in pairs:  # every candidate is its own clamp fixpoint
+        assert _pick_block(bq, 512) == bq and _pick_block(bk, 512) == bk
+    # short sequences collapse the grid instead of offering dead configs
+    short = [(c["block_q"], c["block_k"]) for c in spaces.flash_blocks(64, 64)]
+    assert short == [(64, 64)]
+
+
+def test_fused_and_engine_spaces():
+    with_don = spaces.fused_step(donate_allowed=True)
+    assert {"remat": 0, "donate": 1} in with_don and len(with_don) == 4
+    no_don = spaces.fused_step(donate_allowed=False)
+    assert all(c["donate"] == 0 for c in no_don) and len(no_don) == 2
+
+    eng = spaces.decode_engine(8, 256)
+    assert all(c["page_size"] <= 256 for c in eng)
+    assert any(c["lane_buckets"] == [1, 2, 4, 8] for c in eng)
+    srv = spaces.serving_buckets(8)
+    assert any(c["buckets"] == [1, 2, 4, 8] for c in srv)
+    assert all(c["buckets"][-1] == 8 for c in srv)
+
+
+# ---------------------------------------------------------------------------
+# tuner + DB
+# ---------------------------------------------------------------------------
+
+def test_deterministic_winner_ties_break_canonically(tune_dir):
+    cands = [{"x": 3}, {"x": 1}, {"x": 2}]
+    winners = []
+    for i in range(2):
+        w = autotune.Tuner(autotune.db()).tune(
+            "t_site", {"run": i}, cands, score_fn=lambda c: 1.0)
+        winners.append(w)
+    # all scores tie: the canonical-JSON smallest candidate wins, twice
+    assert winners == [{"x": 1}, {"x": 1}]
+    w = autotune.Tuner(autotune.db()).tune(
+        "t_site", {"run": 3}, cands, score_fn=lambda c: c["x"])
+    assert w == {"x": 1}
+
+
+def test_db_roundtrip_fresh_instance(tune_dir):
+    key = {"seq": 7, "flavor": "roundtrip"}
+    _put("rt_site", key, {"block": 256})
+    ent = atdb.TuningDB(tune_dir).get("rt_site", key)  # fresh: disk only
+    assert ent is not None and ent["config"] == {"block": 256}
+    assert atdb.TuningDB(tune_dir).get("rt_site", {"seq": 8}) is None
+
+
+def test_off_mode_is_inert(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_AUTOTUNE", "off")
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path / "never"))
+    _reset()
+    assert autotune.lookup("any", {"k": 1}) is None
+    assert autotune.get_or_tune("any", {"k": 1}, candidates=[{"a": 1}],
+                                score_fn=lambda c: 0.0,
+                                default={"a": 9}) == {"a": 9}
+    assert autotune.cache_fingerprint() is None
+    assert not os.path.exists(str(tmp_path / "never"))
+
+
+def test_stale_env_entry_invalidates(tune_dir, monkeypatch):
+    key = {"seq": 5}
+    _put("env_site", key, {"block": 128})
+    # poison the persisted env fingerprint: the entry must miss, loudly
+    path = os.path.join(tune_dir, atdb.ls_entries(tune_dir)[0]["digest"]
+                        + atdb.ENTRY_SUFFIX)
+    meta, payload = atdb._STORE.read_payload(path)
+    meta["env"]["jaxlib"] = "0.0.0-other"
+    os.remove(path + ".crc32")
+    os.remove(path)
+    atdb._STORE.write_entry(tune_dir, meta["digest"], meta, payload)
+    _reset()
+    telemetry.enable(trace=False)
+    assert autotune.lookup("env_site", key) is None
+    assert "autotune_invalidate" in [e["kind"] for e in telemetry.events()]
+
+
+# ---------------------------------------------------------------------------
+# chaos: corruption and injected faults degrade, never crash
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_corrupt_entry_degrades_to_default(tune_dir):
+    key = {"seq": 11}
+    _put("chaos_site", key, {"block": 256})
+    entry = os.path.join(tune_dir, atdb.ls_entries(tune_dir)[0]["digest"]
+                         + atdb.ENTRY_SUFFIX)
+    with open(entry, "r+b") as f:  # flip payload bytes: CRC must catch it
+        f.seek(-4, os.SEEK_END)
+        f.write(b"\xde\xad\xbe\xef")
+    _reset()
+    telemetry.enable(trace=False)
+    assert autotune.lookup("chaos_site", key) is None
+    assert autotune.stats()["errors"] >= 1
+    assert "autotune_corrupt" in [e["kind"] for e in telemetry.events()]
+    # the tuning loop treats the corrupt entry as a plain miss: get_or_tune
+    # re-tunes and REPLACES it
+    w = autotune.get_or_tune("chaos_site", key, candidates=[{"block": 512}],
+                             score_fn=lambda c: 0.0)
+    assert w == {"block": 512}
+    _reset()
+    assert autotune.lookup("chaos_site", key) == {"block": 512}
+
+
+@pytest.mark.chaos
+def test_injected_load_ioerr_degrades(tune_dir):
+    key = {"seq": 13}
+    _put("chaos_site", key, {"block": 128})
+    _reset()
+    with faults.inject("autotune.load:ioerr=1") as plan:
+        assert autotune.lookup("chaos_site", key) is None
+        assert ("autotune.load", "ioerr", 1) in plan.events
+    assert autotune.stats()["errors"] >= 1
+    _reset()  # fault cleared: the entry is intact and loads fine
+    assert autotune.lookup("chaos_site", key) == {"block": 128}
+
+
+@pytest.mark.chaos
+def test_torn_store_leaves_no_entry(tune_dir):
+    with faults.inject("autotune.store:partial=1@0.5"):
+        _put("chaos_site", {"seq": 17}, {"block": 64})
+    leftovers = [n for n in os.listdir(tune_dir)
+                 if n.endswith(atdb.ENTRY_SUFFIX)] \
+        if os.path.isdir(tune_dir) else []
+    assert not leftovers, "torn store left a partial entry"
+    assert autotune.stats()["errors"] >= 1
+    _reset()  # memory copy died with the process-equivalent reset
+    assert autotune.lookup("chaos_site", {"seq": 17}) is None
+
+
+@pytest.mark.chaos
+def test_strict_mode_raises_on_corrupt(tune_dir, monkeypatch):
+    key = {"seq": 19}
+    _put("chaos_site", key, {"block": 256})
+    entry = os.path.join(tune_dir, atdb.ls_entries(tune_dir)[0]["digest"]
+                         + atdb.ENTRY_SUFFIX)
+    with open(entry, "r+b") as f:
+        f.seek(-4, os.SEEK_END)
+        f.write(b"\xde\xad\xbe\xef")
+    _reset()
+    monkeypatch.setenv("MXNET_AUTOTUNE_STRICT", "1")
+    with pytest.raises(Exception):
+        autotune.lookup("chaos_site", key)
+
+
+# ---------------------------------------------------------------------------
+# compile-cache integration
+# ---------------------------------------------------------------------------
+
+def _tiny_forward(seed=0):
+    rng = np.random.RandomState(seed)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=HID,
+                                name="fc")
+    params = {
+        "fc_weight": mx.nd.array(rng.randn(HID, IN_DIM).astype(np.float32)),
+        "fc_bias": mx.nd.array(rng.randn(HID).astype(np.float32)),
+    }
+    X = rng.randn(2, IN_DIM).astype(np.float32)
+    pred = mx.Predictor(net, params, {"data": X.shape})
+    return pred.forward(data=X)[0].asnumpy()
+
+
+def test_tuned_and_untuned_never_share_a_cache_entry(tune_dir, tmp_path,
+                                                     monkeypatch):
+    """Turning the autotuner on re-keys every executable: the same
+    program forwards into a SECOND cache entry, so a tuned fleet can
+    never deserialize an untuned executable (or vice versa)."""
+    ccdir = str(tmp_path / "cc")
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", ccdir)
+    monkeypatch.setenv("MXNET_AUTOTUNE", "off")
+    _reset()
+    out_untuned = _tiny_forward()
+    assert len(cc.ls_entries(ccdir)) == 1
+    monkeypatch.setenv("MXNET_AUTOTUNE", "on")
+    _reset()
+    out_tuned = _tiny_forward()
+    entries = cc.ls_entries(ccdir)
+    assert len(entries) == 2, \
+        "autotune mode change did not re-key the executable"
+    np.testing.assert_array_equal(out_untuned, out_tuned)
+    # same mode again: the tuned entry is a plain hit, no third entry
+    _reset()
+    _tiny_forward()
+    assert len(cc.ls_entries(ccdir)) == 2
+    assert cc.stats()["hits"] >= 1
+
+
+def test_aot_bundle_carries_tuning_entries(tune_dir, tmp_path, monkeypatch):
+    key = {"seq_q": 512, "seq_k": 512, "head_dim": 128,
+           "dtype": "float32", "causal": True}
+    _put("flash_attention", key, {"block_q": 128, "block_k": 512})
+    bundle = str(tmp_path / "bundle")
+    cc.save_bundle(bundle, entries=[])
+    assert cc.read_manifest(bundle).get("autotune_entries") == 1
+    assert os.path.isdir(os.path.join(bundle, "autotune"))
+
+    # fresh replica: NO tuning dir of its own, lookup mode — the bundle
+    # overlay alone must supply the winner
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path / "empty"))
+    monkeypatch.setenv("MXNET_AUTOTUNE", "on")
+    _reset()
+    assert autotune.lookup("flash_attention", key) is None
+    _reset()
+    cc.attach_bundle(bundle)
+    assert autotune.lookup("flash_attention", key) == \
+        {"block_q": 128, "block_k": 512}
+    assert autotune.stats()["hits"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# tunable sites
+# ---------------------------------------------------------------------------
+
+def test_flash_resolve_uses_db_winner(tune_dir, monkeypatch):
+    key = {"seq_q": 512, "seq_k": 512, "head_dim": 128,
+           "dtype": "float32", "causal": True}
+    _put("flash_attention", key, {"block_q": 128, "block_k": 512})
+    monkeypatch.setenv("MXNET_AUTOTUNE", "on")
+    _reset()
+    assert resolve_blocks(None, None, 512, 512, head_dim=128,
+                          dtype=np.dtype("float32"), causal=True) \
+        == (128, 512)
+    # explicit blocks always win over the DB
+    assert resolve_blocks(256, 256, 512, 512, head_dim=128,
+                          dtype=np.dtype("float32"), causal=True) \
+        == (256, 256)
+
+
+def test_fused_step_site_records_winner(tune_dir, monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_STEP", "1")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, IN_DIM))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Xavier(), force_init=True)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1},
+                       force_init=True)
+    rng = np.random.RandomState(3)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randn(4, IN_DIM).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 8, (4,)).astype(np.float32))],
+        pad=0)
+    mod.forward_backward(batch)
+    mod.update()
+    ex = mod._exec_group.execs[0]
+    tuned = getattr(ex, "_fused_autotune", None)
+    assert tuned is not None and set(tuned) == {"remat", "donate"}
+    s = autotune.stats()
+    assert s["stores"] >= 1 and s["tuning_ms"] > 0
+    assert atdb.ls_entries(tune_dir), "fused-step winner not persisted"
+
+
+def test_decode_engine_constructor_consults_db(tune_dir, monkeypatch):
+    V, LAYERS, HEADS, HIDDEN, S = 64, 2, 2, 32, 32
+    net = mx.models.get_transformer_lm(vocab_size=V, num_layers=LAYERS,
+                                       num_heads=HEADS, hidden=HIDDEN,
+                                       seq_len=S)
+    arg_shapes, _, _ = net.infer_shape(data=(1, S), softmax_label=(1, S))
+    rng = np.random.RandomState(0)
+    params = {
+        name: mx.nd.array(rng.randn(*shp).astype(np.float32) * 0.05)
+        for name, shp in zip(net.list_arguments(), arg_shapes)
+        if name not in ("data", "softmax_label")}
+    key = {"num_layers": LAYERS, "num_heads": HEADS,
+           "head_dim": HIDDEN // HEADS, "max_seq_len": S,
+           "max_lanes": 8, "dtype": "float32"}
+    _put("decode_engine", key,
+         {"page_size": 8, "lane_buckets": [1, 2, 4, 8]})
+    monkeypatch.setenv("MXNET_AUTOTUNE", "on")
+    _reset()
+    from mxnet_tpu.generation import DecodeEngine
+    eng = DecodeEngine(params, vocab_size=V, num_layers=LAYERS,
+                       num_heads=HEADS, hidden=HIDDEN, max_seq_len=S,
+                       num_pages=48, prefill_len_buckets=(8, 16, 32),
+                       warmup=False, start=False)
+    try:
+        assert eng.page_size == 8
+        assert eng.lane_buckets == (1, 2, 4, 8)
+        assert autotune.stats()["hits"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_serving_bucket_site_consults_db(tune_dir, monkeypatch):
+    _put("serving_buckets", {"max_batch": 4}, {"buckets": [2, 4]})
+    monkeypatch.setenv("MXNET_AUTOTUNE", "on")
+    _reset()
+    from mxnet_tpu.serving.server import _autotune_buckets
+    assert _autotune_buckets(4) == [2, 4]
+    monkeypatch.setenv("MXNET_AUTOTUNE", "off")
+    assert _autotune_buckets(4) is None
+
+
+# ---------------------------------------------------------------------------
+# tier-1 guard: the benched shape's block clamping (satellite of record)
+# ---------------------------------------------------------------------------
+
+def test_default_blocks_at_benched_shape(monkeypatch):
+    """With the autotuner OFF, the s=8192 bench shape must resolve to
+    the PERF.md-validated 512/512 (and never bk < bq, which starves the
+    MXU contraction)."""
+    monkeypatch.delenv("MXNET_AUTOTUNE", raising=False)
+    bq, bk = resolve_blocks(None, None, 8192, 8192, head_dim=128,
+                            dtype="bfloat16", causal=True)
+    assert (bq, bk) == (512, 512)
+    assert bk >= bq
+
+
+# ---------------------------------------------------------------------------
+# admin CLI
+# ---------------------------------------------------------------------------
+
+def test_admin_ls_verify_prune_show(tune_dir):
+    _put("flash_attention", {"seq_q": 512}, {"block_q": 128})
+
+    def run(*args):
+        return subprocess.run([sys.executable, ADMIN, *args,
+                               "--dir", tune_dir],
+                              capture_output=True, text=True, timeout=120)
+
+    ls = run("ls", "--json")
+    assert ls.returncode == 0, ls.stderr[-800:]
+    entries = json.loads(ls.stdout)
+    assert len(entries) == 1 and entries[0]["site"] == "flash_attention"
+    ver = run("verify", "--json")
+    assert ver.returncode == 0 and json.loads(ver.stdout)["bad"] == 0
+    show = run("show-winner", entries[0]["digest"])
+    assert show.returncode == 0
+    assert json.loads(show.stdout)["config"] == {"block_q": 128}
+    assert run("prune", "--max-mb", "64").returncode == 0
+    assert atdb.ls_entries(tune_dir)  # under budget: nothing pruned
+
+    # corrupt the entry: verify must flag it and exit non-zero
+    path = entries[0]["path"]
+    with open(path, "r+b") as f:
+        f.seek(-4, os.SEEK_END)
+        f.write(b"\xde\xad\xbe\xef")
+    bad = run("verify", "--json")
+    assert bad.returncode == 1 and json.loads(bad.stdout)["bad"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the acceptance path: tune once, every later process starts tuned
+# ---------------------------------------------------------------------------
+
+def _run_worker(tune_dir, mode):
+    env = dict(os.environ, MXNET_AUTOTUNE=mode, MXNET_AUTOTUNE_DIR=tune_dir,
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, WORKER], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_fresh_process_inherits_winner_zero_retuning(tune_dir):
+    """Record mode pays the tuning cost and picks a NON-default winner
+    by the cost proxy; a fresh lookup-mode process lowers with the tuned
+    config off the DB — hits, no misses, zero tuning milliseconds."""
+    first = _run_worker(tune_dir, "record")
+    assert first["stats"]["stores"] >= 1
+    assert first["stats"]["tuning_ms"] > 0
+    assert tuple(first["blocks"]) != (512, 512), \
+        "tuning picked the default — the acceptance shape must move"
+
+    second = _run_worker(tune_dir, "on")
+    assert second["blocks"] == first["blocks"]
+    assert second["stats"]["hits"] >= 1
+    assert second["stats"]["misses"] == 0
+    assert second["stats"]["tuning_ms"] == 0, "lookup mode re-tuned"
+    assert second["fingerprint"] == first["fingerprint"]

@@ -17,10 +17,12 @@ tunnel death keeps everything already measured:
 A watchdog hard-exits (code 3) if the backend init hangs >8min — a dead
 tunnel costs minutes, and the process never wedges a watcher cycle.
 
-Usage: python tools/capture_once.py [--skip-resnet] >> capture.jsonl
+Results stream to stdout AND to capture.jsonl under the telemetry
+artifact dir (MXNET_TELEMETRY_DUMP_DIR) — never the working tree.
+
+Usage: python tools/capture_once.py [--skip-resnet]
 """
 import argparse
-import json
 import os
 import sys
 import threading
@@ -30,10 +32,12 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from artifact_io import tee_line  # noqa: E402
+
 
 def emit(name, **kw):
-    print(json.dumps({"capture": name, "t": round(time.time(), 1), **kw}),
-          flush=True)
+    tee_line("capture.jsonl",
+             {"capture": name, "t": round(time.time(), 1), **kw})
 
 
 def emit_partial(reason):

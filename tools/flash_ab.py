@@ -5,15 +5,19 @@ dimension_semantics commit; the round-5 checklist measured 12.6 at the
 same blocks AFTER it. This sweeps the 2x2 variant grid through the same
 run_bench harness to attribute the regression.
 
+Results stream to stdout AND to flash_ab.jsonl under the telemetry
+artifact dir (MXNET_TELEMETRY_DUMP_DIR) — never the working tree.
+
 Usage: python tools/flash_ab.py [--seq 8192] [--steps 10]
 """
 import argparse
-import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from artifact_io import tee_line  # noqa: E402
 
 
 def main():
@@ -37,15 +41,15 @@ def main():
                     with deadline(600):
                         r = run_bench(seq=cli.seq, steps=cli.steps,
                                       block_q=bq, block_k=bk)
-                    print(json.dumps({"exp2": exp2, "dimsem": dimsem,
-                                      "bq": bq, "bk": bk,
-                                      "tflops": r["value"],
-                                      "step_ms": r["step_ms"],
-                                      "mfu": r["mfu"]}), flush=True)
+                    tee_line("flash_ab.jsonl",
+                             {"exp2": exp2, "dimsem": dimsem,
+                              "bq": bq, "bk": bk, "tflops": r["value"],
+                              "step_ms": r["step_ms"], "mfu": r["mfu"]})
                 except Exception as e:
-                    print(json.dumps({"exp2": exp2, "dimsem": dimsem,
-                                      "bq": bq, "bk": bk,
-                                      "error": str(e)[:160]}), flush=True)
+                    tee_line("flash_ab.jsonl",
+                             {"exp2": exp2, "dimsem": dimsem,
+                              "bq": bq, "bk": bk,
+                              "error": str(e)[:160]})
 
 
 if __name__ == "__main__":

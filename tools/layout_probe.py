@@ -3,6 +3,7 @@ on representative ResNet-50 shapes, pure JAX, bf16.  Quantifies what layout
 conversion is worth before touching the framework ops.
 """
 import json
+import os
 import sys
 import time
 
@@ -10,6 +11,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.hlo_analysis import peak_flops  # noqa: E402
 
 B = int(sys.argv[1]) if len(sys.argv) > 1 else 256
 
@@ -68,4 +73,4 @@ for layout in ("NCHW", "NHWC"):
     t, f = bench(layout)
     print(json.dumps({"layout": layout, "total_ms": round(t * 1e3, 2),
                       "tflops": round(f / t / 1e12, 1),
-                      "mfu": round(f / t / 197e12, 3)}))
+                      "mfu": round(f / t / peak_flops(), 3)}))

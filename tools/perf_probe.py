@@ -11,13 +11,14 @@ Usage:
 """
 import argparse
 import collections
-import json
 import os
 import re
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.artifact_io import write_json  # noqa: E402
 
 
 def build_module(batch):
@@ -48,47 +49,10 @@ def build_module(batch):
     return mod, train
 
 
-def bn_fusion_analysis(hlo_text):
-    """Does BN's scale/shift ride the conv epilogue? (VERDICT r4 ask.)
-
-    Classifies every convolution by actual dataflow, not substring
-    presence: a conv counts as epilogue-fused only when its RESULT name
-    is an operand of a multiply/add/subtract inside the same non-entry
-    fusion computation (the BN affine transform then costs no extra HBM
-    round trip). Convs in the ENTRY computation are bare by definition —
-    entry-level instructions are separate kernels even when an
-    elementwise op consumes them there (worth ~2 MFU points per PERF.md's
-    control-minus-BN-stats data if that is where BN's scale/shift run)."""
-    # computations: optional ENTRY prefix, then 'name (...) -> ... {'.
-    # The '%' name sigil is optional THROUGHOUT: modern compiled.as_text()
-    # dumps omit it ('convolution.3 = f32[...] convolution(arg.1, ...)'),
-    # classic dumps keep it — names are normalized sigil-less.
-    blocks = re.findall(r"^(ENTRY\s+)?%?[\w.-]+ [^\n]*\{\n(.*?)^\s*\}",
-                        hlo_text, re.M | re.S)
-    fused = fused_plain = bare = 0
-    for entry_prefix, body in blocks:
-        conv_names = [m.group(1).lstrip("%") for m in re.finditer(
-            r"(%?[\w.-]+)\s*=\s*\S+\s+convolution\(", body)]
-        if not conv_names:
-            continue
-        if entry_prefix:
-            bare += len(conv_names)
-            continue
-        ew_operands = set()
-        for m in re.finditer(
-                r"=\s*\S+\s+(?:multiply|add|subtract)\(([^)]*)\)", body):
-            ew_operands.update(
-                t.lstrip("%")
-                for t in re.findall(r"%?[\w][\w.-]*", m.group(1)))
-        for c in conv_names:
-            if c in ew_operands:
-                fused += 1
-            else:
-                fused_plain += 1
-    return {"convs_total": fused + fused_plain + bare,
-            "convs_fused_with_elementwise_epilogue": fused,
-            "convs_fused_plain": fused_plain,
-            "convs_bare_in_entry": bare}
+# re-exported for back-compat: the analysis now lives in the shared
+# mxnet_tpu.hlo_analysis module (the autotuner uses it too)
+from mxnet_tpu.hlo_analysis import bn_fusion_analysis  # noqa: E402,F401
+from mxnet_tpu.hlo_analysis import hlo_op_counts  # noqa: E402
 
 
 def main():
@@ -129,14 +93,10 @@ def main():
             report["cost_analysis_error"] = str(e)
             compiled = fn.lower(*abstract).compile()
         hlo = compiled.as_text()
-        ops = collections.Counter(
-            re.findall(r"^\s*[%\w.-]+ = [\w\[\]<>{}, ]*?(\w+)\(", hlo,
-                       re.M))
-        interesting = {k: v for k, v in ops.most_common()
-                       if k in ("transpose", "copy", "convolution", "fusion",
-                                "custom-call", "all-reduce", "reshape",
-                                "bitcast", "dot")}
-        report["hlo_op_counts"] = interesting
+        report["hlo_op_counts"] = hlo_op_counts(
+            hlo, interesting=("transpose", "copy", "convolution", "fusion",
+                              "custom-call", "all-reduce", "reshape",
+                              "bitcast", "dot"))
         # count convs whose operand/result types are bf16
         convs = re.findall(r"= (\S+) convolution\(", hlo)
         report["conv_result_dtypes"] = dict(collections.Counter(
@@ -170,7 +130,7 @@ def main():
         report["mfu_xla_flops"] = round(
             report["xla_flops"] / (dt / cli.num_steps)
             / telemetry.peak_flops(), 4)
-    print(json.dumps(report, indent=2))
+    write_json("perf_probe.json", report)
 
 
 if __name__ == "__main__":

@@ -16,20 +16,25 @@ After the checklist, run ``python tools/perf_probe.py`` separately for
 the XLA cost analysis + bn_fusion classification (it builds its own
 Module; keeping it out-of-process avoids doubling HBM residency).
 
+Results stream to stdout AND to checklist.jsonl under the telemetry
+artifact dir (MXNET_TELEMETRY_DUMP_DIR) — never the working tree.
+
 Usage: python tools/tpu_checklist.py [--skip-resnet] [--skip-oracle]
 """
 import argparse
-import json
 import os
 import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+from artifact_io import tee_line  # noqa: E402
 
 
 def report(name, **kw):
-    print(json.dumps({"check": name, **kw}), flush=True)
+    tee_line("checklist.jsonl", {"check": name, **kw})
 
 
 def main():
