@@ -85,6 +85,10 @@ def _arg_parser():
                     help="omit the CPU-only kvstore transport phase")
     ap.add_argument("--kvstore-timeout", type=int, default=240,
                     help="seconds before the kvstore subprocess is killed")
+    ap.add_argument("--skip-sparse", action="store_true",
+                    help="omit the CPU-only sparse parameter plane phase")
+    ap.add_argument("--sparse-timeout", type=int, default=300,
+                    help="seconds before the sparse subprocess is killed")
     ap.add_argument("--skip-shard-probe", action="store_true",
                     help="omit the CPU-only GSPMD sharding smoke phase")
     ap.add_argument("--shard-probe-timeout", type=int, default=600,
@@ -404,6 +408,35 @@ def _kvstore_fields(timeout=240):
                                            "; ".join(tail[-2:])[:300])}
 
 
+def _sparse_fields(timeout=300):
+    """CPU-only sparse parameter plane phase (tools/bench_sparse.py) in a
+    subprocess: touched-rows push+pull over sharded embedding tables vs
+    the dense full-table push a sparse-less kvstore would pay each step,
+    plus the flat-worker-memory check."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_sparse.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run([sys.executable, script],
+                              capture_output=True, text=True,
+                              timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return {"sparse_error": "sparse phase killed after %ds" % timeout}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        return {"sparse_pushpull_rows_s": rec.get("sparse_rows_s"),
+                "sparse_step_ms": rec.get("sparse_step_ms"),
+                "sparse_vs_dense_fulltable": rec.get("vs_baseline"),
+                "sparse_worker_bytes_flat":
+                    rec.get("worker_bytes_flat_vs_table")}
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return {"sparse_error": "rc=%d %s" % (proc.returncode,
+                                          "; ".join(tail[-2:])[:300])}
+
+
 def _shard_probe_fields(timeout=600):
     """CPU-only GSPMD sharding smoke (tools/shard_probe.py) on a simulated
     8-device mesh: megatron-ruled transformer LM fused step, reporting the
@@ -565,6 +598,8 @@ def orchestrate(argv=None):
     # survive every early return below (dead tunnel included)
     kv_fields = {} if cli.skip_kvstore else \
         _kvstore_fields(cli.kvstore_timeout)
+    sparse_fields = {} if cli.skip_sparse else \
+        _sparse_fields(cli.sparse_timeout)
     shard_fields = {} if cli.skip_shard_probe else \
         _shard_probe_fields(cli.shard_probe_timeout)
     coldstart_fields = {} if cli.skip_coldstart else \
@@ -574,6 +609,7 @@ def orchestrate(argv=None):
 
     def finish(rec):
         rec.update(kv_fields)
+        rec.update(sparse_fields)
         rec.update(shard_fields)
         rec.update(coldstart_fields)
         rec.update(generate_fields)

@@ -80,6 +80,9 @@ from . import kvstore as kv
 # (reference python/mxnet/kvstore_server.py:58 _init_kvstore_server_module)
 from . import kvstore_server
 from . import comm_engine
+# row-sparse values + the sharded-embedding-table plane; already loaded
+# (minus its lazy layers) by kvstore_server's row_merge import
+from . import sparse
 from . import sharding
 from . import model
 from . import module

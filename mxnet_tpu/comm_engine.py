@@ -619,6 +619,19 @@ class AsyncKVStore(KVStore):
         except MXNetError:
             return True  # drained; pending failures surfaced and dropped
 
+    def sparse_plane(self):
+        """Build (once) the row-sparse parameter plane bound to this
+        engine: sparse pushes ride the same per-key FIFO chains as dense
+        traffic, so they pipeline with compute and a pull always observes
+        the pushes submitted before it (docs/how_to/sparse.md)."""
+        plane = self.__dict__.get("_sparse_plane")
+        if plane is None:
+            from .sparse.plane import SparseParamPlane
+
+            plane = SparseParamPlane(self)
+            self.__dict__["_sparse_plane"] = plane
+        return plane
+
     # -- control plane (drain first: ordering + recovery semantics) --------
     def init(self, key, value):
         self.wait_all()

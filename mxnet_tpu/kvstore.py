@@ -208,13 +208,17 @@ class DistAsyncKVStore(KVStore):
         from . import kvstore_server as kvs
 
         host = os.environ.get("DMLC_PS_ROOT_URI")
-        if host:
+        # DMLC_SERVER_URIS ("h1:p1,h2:p2") is the launcher's authoritative
+        # server list and stands on its own — no root URI needed (the
+        # sparse-plane tests point a worker at already-running servers
+        # this way)
+        uris = os.environ.get("DMLC_SERVER_URIS")
+        if host or uris:
             port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
             self._server = None
-            # multi-server fleet: DMLC_SERVER_URIS ("h1:p1,h2:p2") when
-            # servers live on different hosts, else root_port+i on the
-            # root host (the launcher starts DMLC_NUM_SERVER of them)
-            uris = os.environ.get("DMLC_SERVER_URIS")
+            # multi-server fleet: DMLC_SERVER_URIS when servers live on
+            # different hosts, else root_port+i on the root host (the
+            # launcher starts DMLC_NUM_SERVER of them)
             if uris:
                 addrs = [(h, int(p)) for h, p in
                          (u.rsplit(":", 1) for u in uris.split(","))]

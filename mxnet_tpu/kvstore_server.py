@@ -28,12 +28,14 @@ import logging
 import os
 import pickle
 import random
+import re
 import socket
 import socketserver
 import struct
 import threading
 import time
 import uuid
+import zlib
 from collections import OrderedDict
 from typing import Dict, Optional
 
@@ -50,6 +52,7 @@ from . import profiler as _prof
 from . import telemetry as _telemetry
 from .base import env as _env
 from .base import register_env
+from .sparse.array import row_merge
 from .telemetry import tracer
 
 __all__ = ["KVStoreServer", "start_server", "ServerClient",
@@ -148,7 +151,10 @@ def _backoff_sleep(attempt, conf):
 # big-key sizes the range split targets (PERF.md table).  The leading
 # version byte turns a mixed-version worker/server pair into a clear
 # error instead of a confusing unpickling failure mid-stream.
-_WIRE_VERSION = 1
+# v2 adds the sparse plane envelopes (init_table / push_rows / pull_rows /
+# table_info / set_sparse_optimizer); dense command tuples are unchanged,
+# but a v1 peer would mis-handle the new commands so the byte is bumped.
+_WIRE_VERSION = 2
 _HDR = struct.Struct("<QI")
 _LEN = struct.Struct("<Q")
 
@@ -257,11 +263,20 @@ def _srv_metrics():
                 "mxtpu_kvsrv_round_skew_ms",
                 "Last sync-merge round's max-minus-median contribution "
                 "wait (ms) — the fleet aggregator's skew source."),
+            "sparse_pushed": reg.counter(
+                "mxtpu_kvsrv_sparse_rows_pushed_total",
+                "Row-sparse gradient rows received via push_rows."),
+            "sparse_pulled": reg.counter(
+                "mxtpu_kvsrv_sparse_rows_pulled_total",
+                "Embedding-table rows served via pull_rows."),
             # per-command latency histograms (incl. the membership RPCs
-            # join/leave/evict/membership) and per-rank round-wait
-            # histograms, created lazily as commands/ranks appear
+            # join/leave/evict/membership and the sparse push_rows/
+            # pull_rows plane) and per-rank round-wait histograms, created
+            # lazily as commands/ranks appear; per-table row/byte gauges
+            # likewise appear as tables are initialized
             "rpc_cmd_ms": {},
             "rank_wait_ms": {},
+            "table_gauges": {},
         }
     return _TELEM
 
@@ -287,6 +302,39 @@ def _rank_wait_hist(m, rank):
             start=0.5, factor=4.0, count=10)
         m["rank_wait_ms"][rank] = h
     return h
+
+
+def _register_table_gauges(server, key):
+    """Per-key callback gauges over a sharded table's local shard: row
+    count and resident bytes.  Callback-style so the scrape always sees
+    the live dict — no per-push bookkeeping on the hot path."""
+    if not _telemetry.enabled():
+        return
+    m = _srv_metrics()
+    if key in m["table_gauges"]:
+        return
+    safe = re.sub(r"[^A-Za-z0-9_]", "_", str(key))
+    reg = _telemetry.registry()
+
+    def _rows(server=server, key=key):
+        tbl = server.tables.get(key)
+        return len(tbl["rows"]) if tbl else 0
+
+    def _bytes(server=server, key=key):
+        tbl = server.tables.get(key)
+        if not tbl:
+            return 0
+        return sum(v.nbytes for v in tbl["rows"].values()) + \
+            sum(v.nbytes for v in tbl["state"].values())
+
+    m["table_gauges"][key] = (
+        reg.gauge("mxtpu_kvsrv_table_rows_%s" % safe,
+                  "Rows resident in this server's shard of table %r."
+                  % (key,), fn=_rows),
+        reg.gauge("mxtpu_kvsrv_table_bytes_%s" % safe,
+                  "Bytes resident in this server's shard of table %r "
+                  "(rows + optimizer state)." % (key,), fn=_bytes),
+    )
 
 
 class KVStoreServer:
@@ -330,6 +378,17 @@ class KVStoreServer:
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
         self._merge: Dict[object, list] = {}
+        # sparse parameter plane (docs/how_to/sparse.md): per-key sharded
+        # embedding tables.  Each entry is {"meta": {...}, "rows":
+        # {row_id: ndarray}, "state": {row_id: ndarray}} — this server
+        # holds ONLY the rows with row_id % num_servers == server_index,
+        # materialized lazily on first touch, with the server-placed
+        # optimizer state beside them.  _sparse_merge mirrors _merge for
+        # sync-mode row-sparse rounds: each round is {rank: (ids, vals)}.
+        self.tables: Dict[object, dict] = {}
+        self.sparse_updater = None
+        self._sparse_merge: Dict[object, list] = {}
+        self.applied_row_pushes = 0  # distinct (non-replayed) push_rows
         # telemetry-only shadow of _merge: per-round {rank: arrival ts}
         # for straggler detection.  A PARALLEL structure because snapshot
         # v3 pickles the _merge round dicts directly — timestamps must
@@ -619,6 +678,100 @@ class KVStoreServer:
                 if not (is_recovery and self.updater is not None):
                     self.updater = opt.get_updater(optimizer)
             return ("ok",)
+        # -- sparse parameter plane (wire v2, docs/how_to/sparse.md) -----
+        if cmd == "init_table":
+            _, key, meta = msg
+            meta = dict(meta)
+            meta.setdefault("num_servers", 1)
+            meta.setdefault("server_index", 0)
+            meta.setdefault("init", ("zeros",))
+            meta.setdefault("dtype", "float32")
+            with self._lock:
+                tbl = self.tables.get(key)
+                if tbl is None:
+                    self.tables[key] = {"meta": meta, "rows": {},
+                                        "state": {}}
+            _register_table_gauges(self, key)
+            return ("ok",)
+        if cmd == "push_rows":
+            faults.fire("kv.server.push_rows")
+            key, row_ids, values = msg[1], msg[2], msg[3]
+            rank = msg[4] if len(msg) > 4 else 0
+            with self._lock:
+                if key not in self.tables:
+                    return ("err", "uninitialized table %r" % (key,))
+                if self.sync_mode and self._members \
+                        and rank not in self._members:
+                    # evicted/left rank's in-flight sparse push: ack but
+                    # keep it out of the survivors' merge rounds
+                    return ("ok",)
+                ids = np.asarray(row_ids, dtype=np.int64).reshape(-1)
+                vals = np.asarray(values)
+                self.applied_row_pushes += 1
+                if _telemetry.enabled():
+                    _srv_metrics()["sparse_pushed"].inc(ids.shape[0])
+                if self.sync_mode:
+                    # per-worker rounds, mirroring the dense push path: a
+                    # fast worker's next-iteration rows must not count
+                    # toward the current round
+                    rounds = self._sparse_merge.setdefault(key, [])
+                    for rnd in rounds:
+                        if rank not in rnd:
+                            rnd[rank] = (ids, vals)
+                            break
+                    else:
+                        rounds.append({rank: (ids, vals)})
+                    self._flush_sparse_rounds_locked(key)
+                else:
+                    # sum-merge duplicate ids first: the writeback is
+                    # per-row, so unmerged duplicates would last-write-win
+                    # instead of adding like the dense scatter
+                    ids, vals = row_merge(ids, vals)
+                    self._apply_rows_locked(key, ids, vals)
+            return ("ok",)
+        if cmd == "pull_rows":
+            faults.fire("kv.server.pull_rows")
+            _, key, row_ids = msg
+            with self._lock:
+                if key not in self.tables:
+                    return ("err", "uninitialized table %r" % (key,))
+                ids = np.asarray(row_ids, dtype=np.int64).reshape(-1)
+                out = self._gather_rows_locked(key, ids)
+                if _telemetry.enabled():
+                    _srv_metrics()["sparse_pulled"].inc(ids.shape[0])
+                return ("ok", out)
+        if cmd == "table_info":
+            want = msg[1] if len(msg) > 1 else None
+            with self._lock:
+                info = {}
+                for key, tbl in self.tables.items():
+                    if want is not None and key != want:
+                        continue
+                    meta = tbl["meta"]
+                    ns = int(meta.get("num_servers", 1))
+                    si = int(meta.get("server_index", 0))
+                    misplaced = sum(1 for r in tbl["rows"]
+                                    if int(r) % ns != si)
+                    info[key] = {
+                        "rows": len(tbl["rows"]),
+                        "state_rows": len(tbl["state"]),
+                        "bytes": (sum(v.nbytes
+                                      for v in tbl["rows"].values())
+                                  + sum(v.nbytes
+                                        for v in tbl["state"].values())),
+                        "misplaced": misplaced,
+                        "meta": dict(meta),
+                    }
+                return ("ok", info)
+        if cmd == "set_sparse_optimizer":
+            is_recovery = bool(msg[2]) if len(msg) > 2 else False
+            updater = pickle.loads(msg[1])
+            with self._lock:
+                # same recovery semantics as the dense updater: a
+                # rejoining rank 0 must not reset live optimizer state
+                if not (is_recovery and self.sparse_updater is not None):
+                    self.sparse_updater = updater
+            return ("ok",)
         if cmd == "heartbeat":
             rank = int(msg[1])
             with self._lock:
@@ -861,12 +1014,18 @@ class KVStoreServer:
                     for rnd in rounds:
                         for r in gone:
                             rnd.pop(r, None)
+                for rounds in self._sparse_merge.values():
+                    for rnd in rounds:
+                        for r in gone:
+                            rnd.pop(r, None)
                 for tss in self._merge_ts.values():
                     for tsr in tss:
                         for r in gone:
                             tsr.pop(r, None)
                 for key in list(self._merge):
                     self._flush_rounds_locked(key)
+                for key in list(self._sparse_merge):
+                    self._flush_sparse_rounds_locked(key)
             gen = self._mgen
             ranks_now = sorted(self._members)
         if gone:
@@ -923,6 +1082,101 @@ class KVStoreServer:
             except Exception as e:
                 logging.warning("kvstore evictor: %s", e)
 
+    # -- sparse tables ------------------------------------------------------
+    @staticmethod
+    def _row_init(meta, key, row_id):
+        """Deterministically materialize one absent row.  The seed is a
+        function of (key, row_id) ONLY — independent of which server owns
+        the row, of arrival order, and of restarts — so resharding or a
+        crash-restart reproduces bit-identical virgin rows."""
+        shape = tuple(meta.get("row_shape", ()))
+        dtype = np.dtype(meta.get("dtype", "float32"))
+        spec = tuple(meta.get("init", ("zeros",)))
+        kind = spec[0]
+        if kind == "zeros":
+            return np.zeros(shape, dtype=dtype)
+        if kind == "constant":
+            return np.full(shape, spec[1], dtype=dtype)
+        seed = zlib.crc32(("%r:%d" % (key, int(row_id))).encode())
+        rng = np.random.RandomState(seed)
+        if kind == "uniform":
+            scale = float(spec[1]) if len(spec) > 1 else 0.01
+            return rng.uniform(-scale, scale, size=shape).astype(dtype)
+        if kind == "normal":
+            std = float(spec[1]) if len(spec) > 1 else 0.01
+            return (rng.standard_normal(size=shape) * std).astype(dtype)
+        raise ValueError("unknown sparse init spec %r" % (spec,))
+
+    def _gather_rows_locked(self, key, ids):
+        """Stack the requested rows into one (n, *row_shape) array,
+        lazily materializing absent rows (caller holds ``_lock``)."""
+        tbl = self.tables[key]
+        rows, meta = tbl["rows"], tbl["meta"]
+        out = np.empty((ids.shape[0],) + tuple(meta.get("row_shape", ())),
+                       dtype=np.dtype(meta.get("dtype", "float32")))
+        for i, r in enumerate(ids):
+            r = int(r)
+            row = rows.get(r)
+            if row is None:
+                row = self._row_init(meta, key, r)
+                rows[r] = row
+            out[i] = row
+        return out
+
+    def _apply_rows_locked(self, key, ids, vals):
+        """Apply a merged row-sparse gradient block to this shard: run the
+        server-placed sparse updater over the touched rows (materializing
+        rows and their optimizer state lazily), or accumulate when no
+        updater is installed (caller holds ``_lock``)."""
+        tbl = self.tables[key]
+        meta = tbl["meta"]
+        weight = self._gather_rows_locked(key, ids)
+        if vals.dtype != weight.dtype:
+            # fp16 wire compression parity with the dense path: server
+            # math runs at the stored precision
+            vals = np.asarray(vals, dtype=weight.dtype)
+        upd = self.sparse_updater
+        if upd is None:
+            weight += vals
+        else:
+            sshape = upd.state_shape(tuple(meta.get("row_shape", ())))
+            if sshape is None:
+                upd.update_rows(weight, vals, None)
+            else:
+                state_rows, states = tbl["state"], None
+                states = np.empty((ids.shape[0],) + tuple(sshape),
+                                  dtype=weight.dtype)
+                for i, r in enumerate(ids):
+                    s = state_rows.get(int(r))
+                    states[i] = 0 if s is None else s
+                upd.update_rows(weight, vals, states)
+                for i, r in enumerate(ids):
+                    state_rows[int(r)] = states[i]
+        rows = tbl["rows"]
+        for i, r in enumerate(ids):
+            rows[int(r)] = weight[i]
+
+    def _flush_sparse_rounds_locked(self, key):
+        """Sparse twin of ``_flush_rounds_locked`` (caller holds
+        ``_lock``): pop every leading complete round, concatenate the
+        member contributions, sum duplicate row ids, renormalize by
+        ``num_workers / len(round)`` when the live set has shrunk, and
+        apply the merged block."""
+        rounds = self._sparse_merge.get(key)
+        while rounds and self._round_complete_locked(rounds[0]):
+            rnd = rounds.pop(0)
+            faults.fire("sparse.merge")
+            self.round_sizes[len(rnd)] = \
+                self.round_sizes.get(len(rnd), 0) + 1
+            ids = np.concatenate([c[0] for c in rnd.values()])
+            vals = np.concatenate([c[1] for c in rnd.values()])
+            ids, vals = row_merge(ids, vals)
+            if self._members and len(rnd) != self.num_workers:
+                vals = np.asarray(
+                    vals * (float(self.num_workers) / len(rnd)),
+                    dtype=vals.dtype)
+            self._apply_rows_locked(key, ids, vals)
+
     def _apply(self, key, grad):
         """Run the updater (reference DataHandle: updater_(key, recved,
         &stored)); without one, accumulate like the reference default."""
@@ -941,8 +1195,12 @@ class KVStoreServer:
     # reply}} (pipelined transport); v1 single-record snapshots are
     # converted on restore.  v3 adds the elastic membership table
     # ("members", "mgen") so a restarted server re-forms around the same
-    # live set instead of forgetting who was in the job.
-    _SNAP_VERSION = 3
+    # live set instead of forgetting who was in the job.  v4 adds the
+    # sparse parameter plane: the sharded embedding tables (rows +
+    # server-placed optimizer state + meta), the sparse updater, pending
+    # sparse merge rounds, and the applied_row_pushes counter — a killed
+    # server restarts with a bit-identical table.
+    _SNAP_VERSION = 4
 
     def snapshot(self):
         """Write the full server state to ``snapshot_path`` atomically
@@ -966,6 +1224,16 @@ class KVStoreServer:
             applied = self.applied_pushes
             members = sorted(self._members)
             mgen = self._mgen
+            tables = {k: {"meta": dict(t["meta"]),
+                          "rows": dict(t["rows"]),
+                          "state": dict(t["state"])}
+                      for k, t in self.tables.items()}
+            sparse_merge = {k: [dict(rnd) for rnd in rounds]
+                            for k, rounds in self._sparse_merge.items()}
+            sparse_updater_bytes = (
+                pickle.dumps(self.sparse_updater, pickle.HIGHEST_PROTOCOL)
+                if self.sparse_updater is not None else None)
+            applied_rows = self.applied_row_pushes
         with self._dedup_cv:
             dedup = {cid: {"floor": rec["floor"],
                            "window": {s: e["reply"]
@@ -984,6 +1252,10 @@ class KVStoreServer:
             "sync_mode": self.sync_mode,
             "members": members,
             "mgen": mgen,
+            "tables": tables,
+            "sparse_merge": sparse_merge,
+            "sparse_updater": sparse_updater_bytes,
+            "applied_row_pushes": applied_rows,
         }
         payload = pickle.dumps(state, pickle.HIGHEST_PROTOCOL)
         atomic_write(self.snapshot_path, lambda f: f.write(payload),
@@ -1013,11 +1285,14 @@ class KVStoreServer:
         try:
             with open(path, "rb") as f:
                 state = pickle.load(f)
-            if state.get("version") not in (1, 2, self._SNAP_VERSION):
+            if state.get("version") not in (1, 2, 3, self._SNAP_VERSION):
                 raise ValueError("snapshot version %r"
                                  % (state.get("version"),))
             updater = (pickle.loads(state["updater"])
                        if state.get("updater") is not None else None)
+            sparse_updater = (
+                pickle.loads(state["sparse_updater"])
+                if state.get("sparse_updater") is not None else None)
         except Exception as e:
             logging.warning("kvstore snapshot %s is unreadable (%s); "
                             "starting cold", path, e)
@@ -1028,6 +1303,16 @@ class KVStoreServer:
                            for k, rounds in state.get("merge", {}).items()}
             self.updater = updater
             self.applied_pushes = int(state.get("applied_pushes", 0))
+            self.tables = {k: {"meta": dict(t["meta"]),
+                               "rows": dict(t["rows"]),
+                               "state": dict(t["state"])}
+                           for k, t in state.get("tables", {}).items()}
+            self._sparse_merge = {
+                k: [dict(rnd) for rnd in rounds]
+                for k, rounds in state.get("sparse_merge", {}).items()}
+            self.sparse_updater = sparse_updater
+            self.applied_row_pushes = int(
+                state.get("applied_row_pushes", 0))
             self._members = set(state.get("members", []))
             self._mgen = int(state.get("mgen", 0))
             now = time.monotonic()
@@ -1041,6 +1326,8 @@ class KVStoreServer:
         with self._dedup_cv:
             self._dedup = self._load_dedup(state.get("dedup", {}),
                                            state.get("version"))
+        for key in self.tables:
+            _register_table_gauges(self, key)
         logging.info("kvstore server restored %d keys (barrier gen %d) "
                      "from %s", len(self.store), self._barrier_gen, path)
         return True
@@ -1440,6 +1727,34 @@ class ServerClient:
     def set_optimizer(self, optimizer, is_recovery=False):
         self._rpc("set_optimizer",
                   pickle.dumps(optimizer, pickle.HIGHEST_PROTOCOL),
+                  int(is_recovery))
+
+    # -- sparse plane (wire v2) --------------------------------------------
+    def init_table(self, key, meta):
+        """Declare a sharded embedding table on this server: ``meta``
+        carries row_shape/dtype/init/num_servers/server_index/num_rows.
+        Idempotent — every worker declares every table."""
+        self._rpc("init_table", key, dict(meta))
+
+    def push_rows(self, key, row_ids, values, rank=0):
+        """Push a row-sparse gradient block (ids must be this shard's)."""
+        self._rpc("push_rows", key,
+                  np.asarray(row_ids, dtype=np.int64), np.asarray(values),
+                  rank)
+
+    def pull_rows(self, key, row_ids):
+        """Fetch rows by id; absent rows materialize deterministically."""
+        return self._rpc("pull_rows", key,
+                         np.asarray(row_ids, dtype=np.int64))
+
+    def table_info(self, key=None):
+        """Shard audit: per-table row/byte counts, misplaced-row count,
+        and meta for this server (the kvstore_admin surface)."""
+        return self._rpc("table_info", key)
+
+    def set_sparse_optimizer(self, updater, is_recovery=False):
+        self._rpc("set_sparse_optimizer",
+                  pickle.dumps(updater, pickle.HIGHEST_PROTOCOL),
                   int(is_recovery))
 
     def barrier(self, rank=0, is_recovery=False):

@@ -50,8 +50,14 @@ def _create_kvstore(kvstore, num_device, arg_params):
 
 
 def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
-                        update_on_kvstore):
+                        update_on_kvstore, skip_indices=()):
+    """``skip_indices``: params routed elsewhere (row_sparse slots ride
+    the sparse plane's sharded tables — initializing them here would ship
+    a dense copy of a table that must never leave the servers)."""
+    skip = frozenset(skip_indices)
     for idx, param_on_devs in enumerate(param_arrays):
+        if idx in skip:
+            continue
         kvstore.init(idx, arg_params[param_names[idx]])
         if update_on_kvstore:
             kvstore.pull(idx, param_on_devs, priority=-idx)

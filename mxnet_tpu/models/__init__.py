@@ -12,8 +12,9 @@ from .vgg import get_vgg
 from .googlenet import get_googlenet
 from .ssd import get_ssd_train, get_ssd_detect, get_ssd_symbols
 from .transformer import get_transformer_lm
+from .dlrm import get_dlrm
 
 __all__ = ["get_ssd_train", "get_ssd_detect", "get_ssd_symbols",
            "get_lenet", "get_mlp", "get_resnet", "get_alexnet",
            "get_inception_bn", "get_inception_v3", "get_vgg",
-           "get_googlenet", "get_transformer_lm"]
+           "get_googlenet", "get_transformer_lm", "get_dlrm"]

@@ -383,7 +383,8 @@ class Module(BaseModule):
                                 param_arrays=self._exec_group.param_arrays,
                                 arg_params=self._arg_params,
                                 param_names=self._param_names,
-                                update_on_kvstore=update_on_kvstore)
+                                update_on_kvstore=update_on_kvstore,
+                                skip_indices=self._sparse_param_indices())
             if not update_on_kvstore and "dist" in kvstore.type and \
                     self._exec_group._multiprocess:
                 # pull the rank-0-broadcast init back so every replica
@@ -415,6 +416,13 @@ class Module(BaseModule):
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
+
+    def _sparse_param_indices(self):
+        """Param indices routed around the dense kvstore path entirely.
+        The base Module has none; SparseEmbeddingModule returns its
+        row_sparse slots, whose tables live sharded on the servers and
+        must never be init'd (or pushed) as dense tensors."""
+        return ()
 
     def _decide_fused(self):
         """Whether update() can run as ONE jitted fwd+bwd+optimizer program

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .param import Param, _np_dtype
 from .registry import register
@@ -30,6 +31,30 @@ def _embedding_infer(attrs, in_shapes):
           infer_shape=_embedding_infer, no_grad_inputs=("data",), hint="embedding")
 def _embedding(opctx, attrs, data, weight):
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+def embedding_row_sparse_grad(data, out_grad, input_dim):
+    """Row-sparse weight gradient for Embedding: the autodiff path scatters
+    out_grad into a dense zeros_like(weight) even when |unique(data)| <<
+    input_dim; this emits only the touched rows as a RowSparseArray.
+
+    data: integer index array of any shape; out_grad: data.shape +
+    (output_dim,).  Allocation is O(touched_rows * output_dim), never
+    O(input_dim).  Summation over duplicate indices matches the dense
+    scatter-add semantics."""
+    from ..sparse.array import RowSparseArray
+
+    data = np.asarray(data).astype(np.int64).reshape(-1)
+    out_grad = np.asarray(out_grad)
+    dim = out_grad.shape[-1]
+    rows = out_grad.reshape(-1, dim)
+    if rows.shape[0] != data.shape[0]:
+        raise ValueError("out_grad rows %d != index count %d"
+                         % (rows.shape[0], data.shape[0]))
+    uniq, inverse = np.unique(data, return_inverse=True)
+    merged = np.zeros((uniq.shape[0], dim), dtype=rows.dtype)
+    np.add.at(merged, inverse, rows)
+    return RowSparseArray(uniq, merged, (int(input_dim), dim))
 
 
 @register("take", inputs=("a", "indices"),

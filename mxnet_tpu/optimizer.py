@@ -83,6 +83,16 @@ class Optimizer:
         self.set_lr_mult({})
         self.set_wd_mult({})
 
+    def __getstate__(self):
+        """Drop the symbol when pickling: it is consulted only at
+        construction (set_lr_mult/set_wd_mult read its attrs into plain
+        dicts, kept) and routinely holds unpicklable op closures — an
+        optimizer shipped to kvstore servers or journaled into a snapshot
+        must not drag the whole graph along."""
+        state = self.__dict__.copy()
+        state["sym"] = None
+        return state
+
     def create_state(self, index, weight):
         """Create the state NDArray(s) for ``index`` (None if stateless)."""
         return None

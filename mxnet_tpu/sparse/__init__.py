@@ -1,0 +1,37 @@
+"""Sparse parameter plane: row-sparse values, sharded embedding tables on
+the kvstore servers, and server-placed optimizers (docs/how_to/sparse.md).
+
+Import discipline: this package is imported by ``kvstore_server`` (for
+``row_merge``) *during* the mxnet_tpu package import, so the eager
+surface here must stay numpy-only.  The plane and module layers — which
+pull in kvstore/comm_engine/module — load lazily on first attribute
+access.
+"""
+from .array import RowSparseArray, row_merge  # noqa: F401
+from .updaters import (SparseAdaGrad, SparseSGD,  # noqa: F401
+                       get_sparse_updater)
+
+__all__ = ["RowSparseArray", "row_merge", "SparseSGD", "SparseAdaGrad",
+           "get_sparse_updater", "SparseParamPlane",
+           "SparseEmbeddingModule"]
+
+_LAZY = {
+    "SparseParamPlane": ("mxnet_tpu.sparse.plane", "SparseParamPlane"),
+    "plane": ("mxnet_tpu.sparse.plane", None),
+    "SparseEmbeddingModule": ("mxnet_tpu.sparse.module",
+                              "SparseEmbeddingModule"),
+    "module": ("mxnet_tpu.sparse.module", None),
+}
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError("module %r has no attribute %r"
+                             % (__name__, name))
+    import importlib
+
+    mod = importlib.import_module(target[0])
+    obj = mod if target[1] is None else getattr(mod, target[1])
+    globals()[name] = obj
+    return obj

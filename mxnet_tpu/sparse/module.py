@@ -1,0 +1,219 @@
+"""SparseEmbeddingModule — Module with per-slot ``stype='row_sparse'``
+embedding params routed through the sparse parameter plane.
+
+The jax autodiff path cannot emit a sparse-shaped cotangent (a vjp's
+output must match the primal's dense shape), so the sparse routing is
+*structural* instead: each row_sparse slot's Embedding weight is bound at
+shape ``(capacity, dim)`` — capacity = the max distinct rows one batch
+can touch, NOT the vocabulary.  Per batch the module
+
+1. uniquifies the slot's raw ids and remaps them to local positions
+   ``[0, n_uniq)`` (np.unique's inverse),
+2. pulls only the touched rows from the server shards into the bound
+   weight buffer (zero-padded to capacity),
+3. runs the normal forward/backward — the weight gradient is the
+   ``(capacity, dim)`` buffer, O(touched) not O(vocab),
+4. pushes ``grad[:n_uniq]`` back under the original row ids (coalesced
+   across slots into one fused envelope per server), where the
+   server-placed optimizer applies it.
+
+Dense params keep the stock Module path untouched.  The full table never
+exists on the worker: resident bytes are O(capacity), the logical table
+can be arbitrarily larger than device memory (docs/how_to/sparse.md).
+"""
+from __future__ import annotations
+
+import copy
+import logging
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..module.module import Module
+from .plane import SparseParamPlane, default_capacity
+from .updaters import from_dense_optimizer
+
+__all__ = ["SparseEmbeddingModule"]
+
+
+class SparseEmbeddingModule(Module):
+    """``sparse_slots`` maps a slot name to its routing config::
+
+        {"slot0": {"data": "slot0_indices",   # index input (a data name)
+                   "weight": "slot0_weight",  # the Embedding weight param
+                   "num_rows": 10_000_000,    # logical vocabulary
+                   "capacity": 4096,          # bound rows (optional)
+                   "init": ("uniform", 0.01)  # server row init (optional)
+                   }}
+
+    The symbol must bind each slot's Embedding with
+    ``input_dim=capacity`` (see models/dlrm.py:get_dlrm, which builds the
+    symbol and this config together)."""
+
+    def __init__(self, symbol, sparse_slots, **kwargs):
+        super().__init__(symbol, **kwargs)
+        self._slots = {}
+        for name, cfg in dict(sparse_slots).items():
+            slot = {"name": name, "data": cfg["data"],
+                    "weight": cfg["weight"],
+                    "num_rows": int(cfg["num_rows"]),
+                    "capacity": int(cfg.get("capacity",
+                                            default_capacity())),
+                    "init": tuple(cfg.get("init", ("uniform", 0.01))),
+                    "uniq": None}
+            if slot["weight"] not in self._param_names:
+                raise MXNetError("row_sparse slot %r: weight %r is not a "
+                                 "parameter of the symbol"
+                                 % (name, slot["weight"]))
+            if slot["data"] not in self._data_names:
+                raise MXNetError("row_sparse slot %r: data %r is not a "
+                                 "data input" % (name, slot["data"]))
+            self._slots[name] = slot
+        self._plane = None
+
+    # -- routing hooks ------------------------------------------------------
+    def _sparse_param_indices(self):
+        weights = {s["weight"] for s in self._slots.values()}
+        return tuple(i for i, n in enumerate(self._param_names)
+                     if n in weights)
+
+    def _decide_fused(self):
+        # the per-batch id remap + row pull/push is inherently eager
+        return False
+
+    @property
+    def sparse_plane(self):
+        return self._plane
+
+    # -- optimizer ----------------------------------------------------------
+    def init_optimizer(self, kvstore="dist_async", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        super().init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                               optimizer_params=optimizer_params,
+                               force_init=force_init)
+        kv = self._kvstore
+        if kv is None or "dist" not in kv.type:
+            raise MXNetError(
+                "SparseEmbeddingModule needs a dist kvstore: the sharded "
+                "embedding tables live on the parameter servers")
+        if hasattr(kv, "sparse_plane"):
+            self._plane = kv.sparse_plane()  # comm-engine FIFO + pipelining
+        else:
+            self._plane = SparseParamPlane(kv)
+        for slot in self._slots.values():
+            i = self._param_names.index(slot["weight"])
+            cap, dim = self._exec_group.param_arrays[i].shape
+            if cap != slot["capacity"]:
+                raise MXNetError(
+                    "slot %r: symbol binds weight rows %d but capacity "
+                    "is %d — build the symbol with input_dim=capacity"
+                    % (slot["name"], cap, slot["capacity"]))
+            slot["param_index"] = i
+            slot["dim"] = int(dim)
+            slot["data_index"] = self._exec_group.data_names.index(
+                slot["data"])
+            slot["key"] = slot["weight"]
+            self._plane.init_table(slot["key"], num_rows=slot["num_rows"],
+                                   row_shape=(dim,), init=slot["init"])
+        # server-placed optimizer: same hyperparameters (incl. the
+        # 1/batch rescale) as the dense slots, state never leaves the
+        # servers
+        self._plane.set_sparse_optimizer(
+            from_dense_optimizer(self._optimizer))
+
+    # -- per-batch routing --------------------------------------------------
+    def _route_sparse(self, data_batch):
+        """Uniquify/remap each slot's ids, pull the touched rows into the
+        bound weight buffers, and return a shallow-copied batch whose
+        index inputs hold local positions."""
+        if self._plane is None or not self._slots:
+            return data_batch
+        batch = copy.copy(data_batch)
+        data = list(batch.data)
+        for slot in self._slots.values():
+            di = slot["data_index"]
+            raw = data[di]
+            ids_np = (raw.asnumpy() if isinstance(raw, nd.NDArray)
+                      else np.asarray(raw))
+            ids = ids_np.astype(np.int64)
+            uniq, inverse = np.unique(ids.reshape(-1), return_inverse=True)
+            if uniq.size > slot["capacity"]:
+                raise MXNetError(
+                    "slot %r: batch touches %d distinct rows > capacity "
+                    "%d — raise the slot capacity (or "
+                    "MXNET_KVSTORE_SPARSE_CAPACITY)"
+                    % (slot["name"], uniq.size, slot["capacity"]))
+            rows = self._plane.pull_rows(slot["key"], uniq)
+            buf = np.zeros((slot["capacity"], slot["dim"]),
+                           dtype=rows.dtype)
+            buf[:uniq.size] = rows
+            self._exec_group.param_arrays[slot["param_index"]]._set(buf)
+            data[di] = nd.array(
+                inverse.reshape(ids.shape).astype(ids_np.dtype))
+            slot["uniq"] = uniq
+        batch.data = data
+        return batch
+
+    def forward(self, data_batch, is_train=None):
+        super().forward(self._route_sparse(data_batch), is_train)
+
+    def forward_backward(self, data_batch):
+        super().forward_backward(self._route_sparse(data_batch))
+
+    def update(self):
+        """Push each slot's touched-row gradient to the servers (one
+        coalesced envelope per server), then run the stock dense update
+        with the sparse grads masked out of the kvstore loop."""
+        eg = self._exec_group
+        pending = []
+        if self._plane is not None:
+            for slot in self._slots.values():
+                uniq = slot.get("uniq")
+                if uniq is None or "param_index" not in slot:
+                    continue
+                g = eg.grad_arrays[slot["param_index"]]
+                if g is None:
+                    continue
+                grad = g.asnumpy()
+                pending.append((slot["key"], uniq, grad[:uniq.size]))
+                slot["uniq"] = None
+            if pending:
+                self._plane.push_rows_multi(pending)
+        saved = {}
+        for slot in self._slots.values():
+            i = slot.get("param_index")
+            if i is not None and eg.grad_arrays[i] is not None:
+                saved[i] = eg.grad_arrays[i]
+                eg.grad_arrays[i] = None
+        try:
+            super().update()
+        finally:
+            for i, g in saved.items():
+                eg.grad_arrays[i] = g
+
+    # -- observability ------------------------------------------------------
+    def sparse_stats(self):
+        """Worker-side plane counters for bench/acceptance: per-slot
+        resident bytes (the bound capacity buffers), logical table bytes,
+        and the plane's transfer peaks."""
+        out = {"slots": {}, "plane": None}
+        for slot in self._slots.values():
+            dim = slot.get("dim")
+            if dim is None:
+                continue
+            itemsize = 4  # float32 tables
+            out["slots"][slot["name"]] = {
+                "resident_bytes": slot["capacity"] * dim * itemsize,
+                "logical_bytes": slot["num_rows"] * dim * itemsize,
+                "capacity": slot["capacity"],
+                "num_rows": slot["num_rows"],
+            }
+        if self._plane is not None:
+            out["plane"] = {
+                "peak_transfer_bytes": self._plane.peak_transfer_bytes,
+                "last_pull_bytes": self._plane.last_pull_bytes,
+                "num_servers": self._plane.num_servers,
+            }
+        return out

@@ -1,0 +1,575 @@
+"""Sparse parameter plane — row-sparse values, sharded tables,
+server-placed optimizers, and the DLRM end-to-end acceptance.
+
+Covers the four layers of mxnet_tpu/sparse/ plus the wire/crash
+contracts they inherit from the elastic kvstore:
+
+* RowSparseArray semantics and the O(touched-rows) Embedding gradient
+  (bit-exact against the dense autodiff gradient);
+* push_rows/pull_rows exactly-once replay under a dropped ACK;
+* `row % num_servers` sharding (no server holds a full table) and
+  deterministic lazy row init;
+* server-placed SGD/AdaGrad parity with a numpy reference, journaled
+  into v4 snapshots and restored bit-exact;
+* sync-mode sparse merge rounds with elastic shrink renormalization;
+* acceptance: a 2-server sharded DLRM where workers stay O(touched),
+  one server is SIGKILLed mid-run and the snapshot-restart resumes
+  bit-identical to an uninterrupted run, and the sparse path matches a
+  dense-embedding reference run bit-exactly on a small table.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults
+from mxnet_tpu import kvstore_server as kvs
+from mxnet_tpu import sparse
+from mxnet_tpu.io import DataBatch
+from mxnet_tpu.ops.indexing import embedding_row_sparse_grad
+from mxnet_tpu.sparse.plane import SparseParamPlane
+from mxnet_tpu.sparse.updaters import (SparseAdaGrad, SparseSGD,
+                                       from_dense_optimizer)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mk_fleet(n, **kw):
+    """n in-process servers + clients + a plane over them."""
+    srvs = [kvs.start_server(port=0, **kw) for _ in range(n)]
+    clients = [kvs.ServerClient(*s.addr) for s in srvs]
+    return srvs, clients, SparseParamPlane(clients)
+
+
+def _stop_fleet(clients):
+    for c in clients:
+        try:
+            c.stop_server()
+        except Exception:
+            pass
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# RowSparse values
+# ---------------------------------------------------------------------------
+
+def test_row_sparse_array_roundtrip_and_merge():
+    dense = np.zeros((10, 3), np.float32)
+    dense[2] = 1.0
+    dense[7] = 2.0
+    rs = sparse.RowSparseArray.from_dense(dense)
+    assert rs.stype == "row_sparse" and rs.nnz == 2
+    np.testing.assert_array_equal(rs.indices, [2, 7])
+    np.testing.assert_array_equal(rs.to_dense(), dense)
+    # duplicate ids sum and canonicalize sorted
+    rs2 = sparse.RowSparseArray([7, 2, 7], np.ones((3, 3), np.float32),
+                                (10, 3))
+    np.testing.assert_array_equal(rs2.indices, [2, 7])
+    np.testing.assert_array_equal(rs2.values[1], np.full(3, 2.0))
+    ids, vals = sparse.row_merge([5, 1, 5, 1],
+                                 np.ones((4, 2), np.float32))
+    np.testing.assert_array_equal(ids, [1, 5])
+    np.testing.assert_array_equal(vals, np.full((2, 2), 2.0))
+
+
+def test_embedding_grad_is_o_touched_rows():
+    """Tier-1 pin: the row_sparse Embedding gradient allocates O(touched
+    rows), never O(vocab) — at vocab=10^6 the dense gradient would be
+    32 MB; the sparse one must stay under 2 MB peak."""
+    import tracemalloc
+
+    vocab, dim = 1_000_000, 8
+    ids = np.array([[5, 999_999, 5, 123_456]], np.int64)
+    og = np.random.RandomState(0).randn(1, 4, dim).astype(np.float32)
+    tracemalloc.start()
+    g = embedding_row_sparse_grad(ids, og, vocab)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert g.shape == (vocab, dim)
+    assert g.values.shape[0] == 3          # distinct ids, not vocab
+    assert peak < 2 << 20, "grad path allocated %d bytes (O(vocab)?)" % peak
+    np.testing.assert_array_equal(g.indices, [5, 123_456, 999_999])
+    # the duplicated id's rows summed
+    np.testing.assert_array_equal(g.values[0], og[0, 0] + og[0, 2])
+
+
+def test_embedding_grad_matches_dense_autodiff_bit_exact():
+    """Same ids/out_grad through the dense autodiff path (full (vocab,
+    dim) cotangent) and the sparse path must agree bit-for-bit."""
+    vocab, dim = 10, 4
+    # each id appears at most twice: a 2-term sum is order-independent
+    # in IEEE float, so bit-exactness is well-defined
+    idx = np.array([1.0, 3.0, 1.0, 7.0, 5.0, 3.0], np.float32)
+    weight = np.random.RandomState(1).randn(vocab, dim).astype(np.float32)
+    data, w = mx.sym.Variable("data"), mx.sym.Variable("weight")
+    s = mx.sym.Embedding(data=data, weight=w, input_dim=vocab,
+                         output_dim=dim)
+    gbuf = mx.nd.zeros((vocab, dim))
+    exe = s.bind(mx.cpu(), {"data": mx.nd.array(idx),
+                            "weight": mx.nd.array(weight)},
+                 args_grad={"weight": gbuf}, grad_req={"weight": "write",
+                                                       "data": "null"})
+    exe.forward(is_train=True)
+    og = np.random.RandomState(2).randn(6, dim).astype(np.float32)
+    exe.backward([mx.nd.array(og)])
+    dense_g = gbuf.asnumpy()
+    sparse_g = embedding_row_sparse_grad(idx, og, vocab)
+    assert sparse_g.to_dense().tobytes() == dense_g.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# wire: exactly-once, sharding, lazy init
+# ---------------------------------------------------------------------------
+
+def test_push_rows_replay_exactly_once(monkeypatch):
+    """A push_rows whose ACK is dropped is replayed under the same
+    idempotency token; the server must not apply it twice."""
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "40")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "20")
+    srv = kvs.start_server(num_workers=1)
+    try:
+        ids = np.array([3, 9], np.int64)
+        vals = np.full((2, 4), 5.0, np.float32)
+        # client recv #1 is the init_table ACK, #2 the push_rows ACK —
+        # dropped after the server has already applied the push
+        with faults.inject("kv.client.recv:drop=1@#2") as plan:
+            with kvs.ServerClient(*srv.addr) as c:
+                c.init_table("t", {"num_rows": 100, "row_shape": (4,),
+                                   "init": ("zeros",)})
+                c.push_rows("t", ids, vals)
+                out = c.pull_rows("t", ids)
+            assert plan.events == [("kv.client.recv", "drop", 2)]
+        np.testing.assert_array_equal(out, vals)
+        assert srv.applied_row_pushes == 1  # replay deduplicated
+    finally:
+        srv.stop()
+
+
+def test_two_server_sharding_no_full_table():
+    """Row r lives on server r % num_servers — each shard holds its half
+    and nothing else; pulls reassemble transparently."""
+    srvs, clients, plane = _mk_fleet(2)
+    try:
+        plane.init_table("emb", num_rows=1000, row_shape=(2,),
+                         init=("zeros",))
+        ids = np.arange(100, dtype=np.int64)
+        vals = np.stack([np.full(2, float(i), np.float32) for i in ids])
+        plane.push_rows("emb", ids, vals)
+        plane.wait("emb")
+        got = plane.pull_rows("emb", ids)
+        np.testing.assert_array_equal(got, vals)
+        infos = plane.table_info()
+        rows = [info["emb"]["rows"] for info in infos]
+        assert rows == [50, 50]            # even/odd split, no full table
+        assert all(info["emb"]["misplaced"] == 0 for info in infos)
+        assert all(r < ids.size for r in rows)
+    finally:
+        _stop_fleet(clients)
+
+
+def test_lazy_row_init_deterministic():
+    """Untouched rows materialize from an RNG seeded by (key, row) —
+    independent of server count, pull order, and restarts."""
+    srvs1, clients1, plane1 = _mk_fleet(1)
+    srvs2, clients2, plane2 = _mk_fleet(2)
+    try:
+        for plane in (plane1, plane2):
+            plane.init_table("emb", num_rows=50, row_shape=(3,),
+                             init=("uniform", 0.1))
+        a = plane1.pull_rows("emb", np.array([7, 3, 11], np.int64))
+        b = plane2.pull_rows("emb", np.array([3, 7, 11], np.int64))
+        assert a[0].tobytes() == b[1].tobytes()   # row 7
+        assert a[1].tobytes() == b[0].tobytes()   # row 3
+        assert a[2].tobytes() == b[2].tobytes()   # row 11
+        # repeat pulls are stable (rows materialized once)
+        a2 = plane1.pull_rows("emb", np.array([7, 3, 11], np.int64))
+        assert a.tobytes() == a2.tobytes()
+        assert a.std() > 0                        # actually random-init
+    finally:
+        _stop_fleet(clients1)
+        _stop_fleet(clients2)
+
+
+# ---------------------------------------------------------------------------
+# server-placed optimizers + snapshots
+# ---------------------------------------------------------------------------
+
+def test_server_placed_updaters_match_numpy_reference():
+    rng = np.random.RandomState(3)
+    g1 = rng.randn(3, 4).astype(np.float32)
+    g2 = rng.randn(3, 4).astype(np.float32)
+    ids = np.array([1, 5, 9], np.int64)
+
+    # SGD with momentum
+    srvs, clients, plane = _mk_fleet(1)
+    try:
+        plane.init_table("t", num_rows=20, row_shape=(4,), init=("zeros",))
+        plane.set_sparse_optimizer(SparseSGD(learning_rate=0.5,
+                                             momentum=0.9))
+        plane.push_rows("t", ids, g1)
+        plane.push_rows("t", ids, g2)
+        plane.wait("t")
+        got = plane.pull_rows("t", ids)
+    finally:
+        _stop_fleet(clients)
+    w = np.zeros((3, 4), np.float32)
+    m = np.zeros((3, 4), np.float32)
+    for g in (g1, g2):
+        m = (0.9 * m - 0.5 * g).astype(np.float32)
+        w = (w + m).astype(np.float32)
+    np.testing.assert_allclose(got, w, rtol=1e-6, atol=1e-7)
+
+    # AdaGrad
+    srvs, clients, plane = _mk_fleet(1)
+    try:
+        plane.init_table("t", num_rows=20, row_shape=(4,), init=("zeros",))
+        plane.set_sparse_optimizer(SparseAdaGrad(learning_rate=0.5,
+                                                 eps=1e-7))
+        plane.push_rows("t", ids, g1)
+        plane.push_rows("t", ids, g2)
+        plane.wait("t")
+        got = plane.pull_rows("t", ids)
+    finally:
+        _stop_fleet(clients)
+    w = np.zeros((3, 4), np.float32)
+    h = np.zeros((3, 4), np.float32)
+    for g in (g1, g2):
+        h = (h + g * g).astype(np.float32)
+        w = (w - 0.5 * g / (np.sqrt(h) + 1e-7)).astype(np.float32)
+    np.testing.assert_allclose(got, w, rtol=1e-6, atol=1e-7)
+
+
+def test_from_dense_optimizer_maps_hyperparams():
+    opt = mx.optimizer.SGD(learning_rate=0.25, wd=0.01, momentum=0.9,
+                           rescale_grad=0.125)
+    upd = from_dense_optimizer(opt)
+    assert isinstance(upd, SparseSGD)
+    assert upd.lr == 0.25 and upd.wd == 0.01 and upd.momentum == 0.9
+    assert upd.rescale_grad == 0.125
+
+
+def test_snapshot_v4_roundtrip_restores_tables_bit_exact(tmp_path):
+    """kill-safety of the sparse state: tables, per-row optimizer state,
+    the installed updater, and the applied-push counter all survive a
+    snapshot/restore round trip bit-exactly."""
+    snap = str(tmp_path / "kv.snap")
+    ids = np.array([2, 3, 8], np.int64)
+    g = np.random.RandomState(4).randn(3, 4).astype(np.float32)
+    srv1 = kvs.start_server(port=0, snapshot_path=snap, snapshot_interval=0)
+    c1 = kvs.ServerClient(*srv1.addr)
+    c1.init_table("t", {"num_rows": 100, "row_shape": (4,),
+                        "init": ("uniform", 0.05)})
+    c1.set_sparse_optimizer(SparseAdaGrad(learning_rate=0.1))
+    c1.push_rows("t", ids, g)
+    before = c1.pull_rows("t", ids)
+    assert c1.snapshot() == snap
+
+    srv2 = kvs.start_server(port=0, snapshot_path=snap, snapshot_interval=0)
+    c2 = kvs.ServerClient(*srv2.addr)
+    try:
+        assert srv2.restored
+        assert srv2.applied_row_pushes == srv1.applied_row_pushes == 1
+        after = c2.pull_rows("t", ids)
+        assert before.tobytes() == after.tobytes()
+        # AdaGrad state restored too: the NEXT step matches on both
+        c1.push_rows("t", ids, g)
+        c2.push_rows("t", ids, g)
+        assert (c1.pull_rows("t", ids).tobytes()
+                == c2.pull_rows("t", ids).tobytes())
+        info = c2.table_info()["t"]
+        assert info["rows"] == 3 and info["state_rows"] == 3
+    finally:
+        for c in (c1, c2):
+            try:
+                c.stop_server()
+            except Exception:
+                pass
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# sync-mode sparse merge rounds + elastic shrink
+# ---------------------------------------------------------------------------
+
+def test_sync_sparse_merge_rounds_shrink_renormalizes():
+    """Sparse pushes accumulate per merge round like dense ones; a
+    2-of-3 round after a leave applies num_workers/len(round) times the
+    merged rows, and a departed rank's push is discarded."""
+    srv = kvs.start_server(num_workers=3, sync_mode=True)
+    host, port = srv.addr
+    ids = np.array([0, 1, 2], np.int64)
+    ones = np.ones((3, 2), np.float32)
+    try:
+        clients = [kvs.ServerClient(host, port) for _ in range(3)]
+        for r, c in enumerate(clients):
+            c.join(r)
+        clients[0].init_table("t", {"num_rows": 10, "row_shape": (2,),
+                                    "init": ("zeros",)})
+        for r in (0, 1, 2):
+            clients[r].push_rows("t", ids, ones, rank=r)
+        np.testing.assert_allclose(clients[0].pull_rows("t", ids),
+                                   np.full((3, 2), 3.0))
+        clients[2].leave(2)
+        for r in (0, 1):
+            clients[r].push_rows("t", ids, ones, rank=r)
+        # 2 contributions renormalized by 3/2 -> the same +3.0 per round
+        np.testing.assert_allclose(clients[0].pull_rows("t", ids),
+                                   np.full((3, 2), 6.0))
+        # a push from the departed rank is acked but discarded
+        clients[2].push_rows("t", ids, np.full((3, 2), 100.0, np.float32),
+                             rank=2)
+        for r in (0, 1):
+            clients[r].push_rows("t", ids, ones, rank=r)
+        np.testing.assert_allclose(clients[0].pull_rows("t", ids),
+                                   np.full((3, 2), 9.0))
+        for c in clients:
+            c.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Module integration: sparse vs dense parity (acceptance d)
+# ---------------------------------------------------------------------------
+
+def _tiny_embed_net(input_dim, dim=4, bag=4):
+    ids = mx.sym.Variable("ids")
+    emb = mx.sym.Embedding(data=ids, input_dim=input_dim, output_dim=dim,
+                           name="embed")
+    pooled = mx.sym.sum(emb, axis=1)
+    fc = mx.sym.FullyConnected(data=pooled, num_hidden=1, name="fc")
+    lab = mx.sym.Variable("y")
+    return mx.sym.LinearRegressionOutput(data=fc, label=lab, name="out")
+
+
+def test_sparse_module_matches_dense_module_bit_exact(monkeypatch):
+    """Acceptance (d): k steps of SparseEmbeddingModule over 2 sharded
+    servers land on bit-identical embedding rows AND dense params vs a
+    plain Module holding the full (vocab, dim) weight locally."""
+    # the dense reference must take the op-by-op update path: the fused
+    # train step lets XLA contract scatter-add + SGD into FMA forms whose
+    # rounding legitimately differs from any op-granular execution
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    vocab, dim, bag, batch, steps = 32, 4, 4, 4, 3
+    rng = np.random.RandomState(7)
+    # unique ids per batch: summation order of duplicate rows is the one
+    # thing the two gradient paths may legally disagree on
+    batches = []
+    for _ in range(steps):
+        ids = rng.choice(vocab, size=batch * bag,
+                         replace=False).reshape(batch, bag)
+        y = rng.randn(batch, 1).astype(np.float32)
+        batches.append((ids.astype(np.float32), y))
+    dense_feats = rng.randn(batch, 1).astype(np.float32)  # unused pad
+
+    opt_params = (("learning_rate", 0.05), ("wd", 0.0), ("momentum", 0.0))
+
+    # dense reference: full-vocab weight, local update
+    dmod = mx.mod.Module(_tiny_embed_net(vocab, dim, bag),
+                         data_names=["ids"], label_names=["y"])
+    dmod.bind(data_shapes=[("ids", (batch, bag))],
+              label_shapes=[("y", (batch, 1))])
+    dmod.init_params(initializer=mx.init.Uniform(0.01))
+    dargs, _ = dmod.get_params()
+    dargs = {k: v.asnumpy().copy() for k, v in dargs.items()}
+    dargs["embed_weight"][:] = 0.0        # match the server zeros init
+    dmod.set_params({k: mx.nd.array(v) for k, v in dargs.items()}, {})
+    dmod.init_optimizer(kvstore=None, optimizer="sgd",
+                        optimizer_params=opt_params)
+
+    # sparse run: capacity-bound weight, 2 sharded servers
+    srvs = [kvs.start_server(port=0) for _ in range(2)]
+    uris = ",".join("%s:%d" % s.addr for s in srvs)
+    monkeypatch.setenv("DMLC_SERVER_URIS", uris)
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    try:
+        slots = {"slot": {"data": "ids", "weight": "embed_weight",
+                          "num_rows": vocab, "capacity": vocab,
+                          "init": ("zeros",)}}
+        smod = sparse.SparseEmbeddingModule(
+            _tiny_embed_net(vocab, dim, bag), sparse_slots=slots,
+            data_names=["ids"], label_names=["y"])
+        smod.bind(data_shapes=[("ids", (batch, bag))],
+                  label_shapes=[("y", (batch, 1))])
+        smod.init_params(arg_params={k: mx.nd.array(v)
+                                     for k, v in dargs.items()},
+                         aux_params={})
+        smod.init_optimizer(kvstore="dist_async", optimizer="sgd",
+                            optimizer_params=opt_params)
+
+        for ids, y in batches:
+            for m in (dmod, smod):
+                m.forward_backward(DataBatch([mx.nd.array(ids)],
+                                             [mx.nd.array(y)]))
+                m.update()
+        smod.sparse_plane.wait()
+
+        table = smod.sparse_plane.pull_rows(
+            "embed_weight", np.arange(vocab, dtype=np.int64))
+        dense_w = dmod.get_params()[0]["embed_weight"].asnumpy()
+        assert table.tobytes() == dense_w.tobytes(), \
+            "sparse embedding rows diverge from the dense reference"
+        # dense (non-sparse) params took the stock path on both modules
+        dfc = dmod.get_params()[0]["fc_weight"].asnumpy()
+        sfc = smod.get_params()[0]["fc_weight"].asnumpy()
+        assert dfc.tobytes() == sfc.tobytes()
+    finally:
+        for s in srvs:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: sharded DLRM, kill -9 a server, resume bit-identical
+# ---------------------------------------------------------------------------
+
+def _dlrm_batches(steps, batch, bag, vocab, dense_dim, seed=11):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(steps):
+        dense = rng.randn(batch, dense_dim).astype(np.float32)
+        s0 = rng.choice(vocab, size=batch * bag, replace=False)
+        s1 = rng.choice(vocab, size=batch * bag, replace=False)
+        y = rng.randint(0, 2, size=(batch, 1)).astype(np.float32)
+        out.append((dense, s0.reshape(batch, bag).astype(np.float32),
+                    s1.reshape(batch, bag).astype(np.float32), y))
+    return out
+
+
+@pytest.mark.chaos
+def test_dlrm_two_server_train_kill_restart_bit_identical(
+        tmp_path, monkeypatch):
+    """The tentpole acceptance: a 2-server sharded DLRM where
+
+    (a) no single server holds the full table,
+    (b) worker-resident param bytes stay O(touched rows) while the
+        logical table is >= 10x the bound buffer,
+    (c) SIGKILL of one server mid-run + snapshot-restart resumes
+        bit-identical to an uninterrupted run.
+    """
+    # the restarted server re-imports the package before it listens:
+    # give replayed RPCs a long runway
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX", "120")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_INITIAL_MS", "10")
+    monkeypatch.setenv("MXNET_KVSTORE_RETRY_MAX_MS", "500")
+    import socket
+
+    from mxnet_tpu.models import get_dlrm
+
+    vocab, dim, cap, bag, batch, dense_dim = 40_000, 16, 128, 4, 8, 8
+    steps, kill_after = 6, 3
+    batches = _dlrm_batches(steps, batch, bag, vocab, dense_dim)
+    probe = _dlrm_batches(1, batch, bag, vocab, dense_dim, seed=99)[0]
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("DMLC_ROLE", None)
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def spawn(port, snap):
+        return subprocess.Popen(
+            [sys.executable,
+             os.path.join(ROOT, "tests", "chaos_kv_server.py"),
+             "127.0.0.1", str(port), snap], env=env, cwd=ROOT)
+
+    def train(tag, interrupt):
+        # identical dense-param init across both runs: initializers draw
+        # from the framework PRNG stream, not global numpy state
+        mx.random.seed(1234)
+        np.random.seed(1234)
+        ports = [free_port(), free_port()]
+        snaps = [str(tmp_path / ("%s-%d.snap" % (tag, i)))
+                 for i in range(2)]
+        procs = [spawn(p, s) for p, s in zip(ports, snaps)]
+        monkeypatch.setenv("DMLC_SERVER_URIS",
+                           ",".join("127.0.0.1:%d" % p for p in ports))
+        monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+        try:
+            sym, slots = get_dlrm(
+                num_slots=2, vocab_sizes=[vocab, vocab], embed_dim=dim,
+                capacity=cap, bag_len=bag, dense_dim=dense_dim,
+                bottom_hidden=(16, 8), top_hidden=(16, 8))
+            mod = sparse.SparseEmbeddingModule(
+                sym, sparse_slots=slots,
+                data_names=["dense", "slot0_indices", "slot1_indices"],
+                label_names=["ctr_label"])
+            mod.bind(data_shapes=[("dense", (batch, dense_dim)),
+                                  ("slot0_indices", (batch, bag)),
+                                  ("slot1_indices", (batch, bag))],
+                     label_shapes=[("ctr_label", (batch, 1))])
+            mod.init_params(initializer=mx.init.Uniform(0.01))
+            mod.init_optimizer(kvstore="dist_async", optimizer="sgd",
+                               optimizer_params=(("learning_rate", 0.05),
+                                                 ("wd", 0.0)))
+            touched = set()
+            for step, (dense, s0, s1, y) in enumerate(batches):
+                touched.update(np.unique(s0).astype(int))
+                touched.update(np.unique(s1).astype(int))
+                mod.forward_backward(DataBatch(
+                    [mx.nd.array(dense), mx.nd.array(s0),
+                     mx.nd.array(s1)], [mx.nd.array(y)]))
+                mod.update()
+                if interrupt and step + 1 == kill_after:
+                    # quiesce -> snapshot both shards -> SIGKILL one
+                    mod.sparse_plane.wait()
+                    kv = mod._kvstore
+                    if hasattr(kv, "wait_all"):
+                        kv.wait_all()
+                    for port, snap in zip(ports, snaps):
+                        with kvs.ServerClient("127.0.0.1", port) as adm:
+                            assert adm.snapshot() == snap
+                    procs[1].kill()       # SIGKILL: no farewell snapshot
+                    procs[1].wait(timeout=30)
+                    procs[1] = spawn(ports[1], snaps[1])
+            mod.sparse_plane.wait()
+
+            # (a) sharding: neither shard holds the full table
+            infos = mod.sparse_plane.table_info()
+            for key in ("slot0_embed_weight", "slot1_embed_weight"):
+                rows = [i[key]["rows"] for i in infos]
+                total = sum(rows)
+                assert all(0 < r < total for r in rows), (key, rows)
+                assert all(i[key]["misplaced"] == 0 for i in infos)
+
+            # (b) worker memory: bound buffers, not the table
+            stats = mod.sparse_stats()
+            for s in stats["slots"].values():
+                assert s["logical_bytes"] >= 10 * s["resident_bytes"]
+
+            ids = np.array(sorted(touched), np.int64)
+            state = [mod.sparse_plane.pull_rows(k, ids).tobytes()
+                     for k in ("slot0_embed_weight", "slot1_embed_weight")]
+            mod.forward(DataBatch(
+                [mx.nd.array(probe[0]), mx.nd.array(probe[1]),
+                 mx.nd.array(probe[2])], [mx.nd.array(probe[3])]),
+                is_train=False)
+            out = mod.get_outputs()[0].asnumpy().tobytes()
+            return state, out
+        finally:
+            for port in ports:
+                try:
+                    with kvs.ServerClient("127.0.0.1", port) as adm:
+                        adm.stop_server()
+                except Exception:
+                    pass
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+
+    clean_state, clean_out = train("clean", interrupt=False)
+    kill_state, kill_out = train("kill", interrupt=True)
+    # (c) bit-identical resume
+    assert kill_state == clean_state, \
+        "sharded tables diverge after kill -9 + snapshot restart"
+    assert kill_out == clean_out, \
+        "model outputs diverge after kill -9 + snapshot restart"

@@ -741,10 +741,80 @@ def run_decode_storm(seed, timeout=120.0, replicas=2, load_threads=3,
     return ok
 
 
+def run_sparse_replay(seed, timeout=120.0):
+    """Exactly-once probe for the sparse wire: one row-sparse push whose
+    ACK the server drops (``kv.server.send:drop=1@#1``).  The client sees
+    a dead connection and replays the request under the SAME idempotency
+    token; the server's dedup window must recognize it and answer from
+    the recorded reply without re-applying.  Passes when the retried run
+    applied exactly one row push and its table rows are bit-identical to
+    an uninterrupted control run."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from mxnet_tpu import faults
+    from mxnet_tpu.kvstore_server import ServerClient, start_server
+
+    rng = np.random.RandomState(seed)
+    ids = np.unique(rng.randint(0, 1000, size=64)).astype(np.int64)
+    vals = rng.randn(ids.size, 8).astype(np.float32)
+    meta = {"num_rows": 1000, "row_shape": (8,), "init": ("zeros",),
+            "dtype": "float32", "num_servers": 1, "server_index": 0}
+
+    def one_run(drop_ack):
+        srv = start_server(port=0)
+        cli = ServerClient(*srv.addr)
+        try:
+            cli.init_table("emb", meta)
+            if drop_ack:
+                # installed only around the push so fire #1 on
+                # kv.server.send is exactly the push_rows ACK
+                with faults.inject("kv.server.send:drop=1@#1", seed):
+                    cli.push_rows("emb", ids, vals)
+            else:
+                cli.push_rows("emb", ids, vals)
+            applied = srv.applied_row_pushes
+            rows = cli.pull_rows("emb", ids)
+            return applied, rows
+        finally:
+            try:
+                cli.stop_server()
+            except Exception:
+                pass
+            cli.close()
+
+    applied_r, rows_r = one_run(drop_ack=True)
+    applied_c, rows_c = one_run(drop_ack=False)
+    ok = True
+    if applied_r != 1:
+        print("chaos_run: sparse-replay applied %d row pushes after the "
+              "dropped-ACK retry, expected exactly 1" % applied_r,
+              file=sys.stderr, flush=True)
+        ok = False
+    if applied_c != 1:
+        print("chaos_run: control run applied %d row pushes, expected 1"
+              % applied_c, file=sys.stderr, flush=True)
+        ok = False
+    if rows_r.tobytes() != rows_c.tobytes():
+        print("chaos_run: sparse-replay table rows diverge from the "
+              "uninterrupted control run (replay was not exactly-once)",
+              file=sys.stderr, flush=True)
+        ok = False
+    if ok:
+        print("chaos_run: sparse-replay ok: dropped ACK, 1 application, "
+              "%d rows bit-identical to control" % ids.size,
+              file=sys.stderr, flush=True)
+    return ok
+
+
 _SCENARIOS = {"membership-churn": run_membership_churn,
               "serving-failover": run_serving_failover,
               "flash-crowd": run_flash_crowd,
-              "decode-storm": run_decode_storm}
+              "decode-storm": run_decode_storm,
+              "sparse-replay": run_sparse_replay}
 
 
 def main():
