@@ -263,6 +263,20 @@ def _autotune_fields(record):
     return record
 
 
+def _guardian_fields(record):
+    """Fold the training-guardian counters into the record when the
+    guardian is on (never allowed to break the bench): a bench number
+    produced alongside skips/rollbacks is not a clean number, and
+    anomaly counts on real hardware are the SDC-rate signal."""
+    try:
+        from mxnet_tpu import guardian
+        if guardian.enabled():
+            record["guardian"] = guardian.stats()
+    except Exception as e:
+        print("guardian stats failed: %r" % (e,), file=sys.stderr)
+    return record
+
+
 def main(argv=None):
     """Single-process bench (the pre-r5 behavior): ResNet first, then the
     flash kernel + transformer-LM secondaries. Used by tpu_checklist
@@ -292,6 +306,7 @@ def main(argv=None):
     # reshapes the headline via _headline()
     _telemetry_fields(record)
     _autotune_fields(record)
+    _guardian_fields(record)
     print(json.dumps(record))
     return record
 
@@ -329,6 +344,7 @@ def _phase(cli):
                           file=sys.stderr)
     _telemetry_fields(record)
     _autotune_fields(record)
+    _guardian_fields(record)
     print(json.dumps(record))
     return record
 

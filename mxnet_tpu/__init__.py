@@ -74,6 +74,7 @@ from . import lr_scheduler
 from . import metric
 from . import callback
 from . import faults
+from . import guardian
 from . import kvstore
 from . import kvstore as kv
 # server-role bootstrap: under DMLC_ROLE=server this serves and exits

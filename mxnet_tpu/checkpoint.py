@@ -23,8 +23,8 @@ from typing import Dict, Optional, Tuple
 from .base import MXNetError
 
 __all__ = ["save_sharded_checkpoint", "load_sharded_checkpoint",
-           "load_partition_specs", "aot_bundle_path", "save_aot_bundle",
-           "attach_aot_bundle"]
+           "load_sharded_checkpoint_state", "load_partition_specs",
+           "aot_bundle_path", "save_aot_bundle", "attach_aot_bundle"]
 
 # written (by process 0) only after every process's shards have landed; a
 # directory without it is a crash-torn save.  Orbax's own commit marker
@@ -36,6 +36,10 @@ _COMPLETE_MARKER = "mxnet_complete"
 # tensor-parallel layout restores onto a fresh mesh (same axis names)
 # without gathering anything to one host first
 _SPEC_FILE = "partition_specs.json"
+
+# framework PRNG stream (mx.random.get_state()) pickled next to the
+# weights — restoring it is half of bit-deterministic resume
+_STATE_FILE = "extra_state.pkl"
 
 
 def _is_complete(path):
@@ -114,11 +118,41 @@ def save_sharded_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                      lambda f: f.write(
                          json.dumps(specs, indent=1).encode()),
                      op="ckpt.write")
+        # PRNG stream state rides inside the directory (same
+        # deterministic-replay contract as model.save_checkpoint's
+        # .state sidecar), landing before the marker like the specs
+        import pickle
+
+        from . import random as _random
+
+        blob = pickle.dumps({"rng": _random.get_state()},
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        atomic_write(os.path.join(path, _STATE_FILE),
+                     lambda f: f.write(blob), op="ckpt.state")
         # the spec file lands BEFORE the marker: a complete checkpoint
         # always has its layout metadata
         atomic_write(os.path.join(path, _COMPLETE_MARKER),
                      lambda f: f.write(b"ok\n"), op="ckpt.write")
     return path
+
+
+def load_sharded_checkpoint_state(prefix, epoch, restore_rng=False):
+    """The extra-state dict saved inside a sharded checkpoint (PRNG
+    stream), or None for pre-state checkpoints.  ``restore_rng`` feeds
+    the stream back into ``mx.random``."""
+    import pickle
+
+    from . import random as _random
+
+    path = os.path.abspath("%s-%04d.orbax" % (prefix, epoch))
+    try:
+        with open(os.path.join(path, _STATE_FILE), "rb") as f:
+            state = pickle.load(f)
+    except OSError:
+        return None
+    if restore_rng and "rng" in state:
+        _random.set_state(state["rng"])
+    return state
 
 
 def load_partition_specs(prefix, epoch):

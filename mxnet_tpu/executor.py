@@ -409,7 +409,8 @@ class Executor:
         a = {k: ns(k) for k in aux}
         o = {k: ns(k) for k in other_args}
         in_s = (d, s, a, o, None, rep, None)
-        out_s = (None, a, d, s)
+        # fifth slot: the step-guard verdict (ok, gnorm) — replicated scalars
+        out_s = (None, a, d, s, rep)
         return in_s, out_s
 
     def _autotune_fused(self, stable_key, abstract_args, make_jit,
@@ -449,14 +450,23 @@ class Executor:
             return None
 
     def _get_fused_step(self, key, update_infos, pure_update, needs_rng,
-                        shardings=None, stable_key=None, abstract_args=None):
+                        shardings=None, stable_key=None, abstract_args=None,
+                        guard=False):
         """Jitted forward+backward+update with donated param/state/aux
         buffers.  This is the whole of the reference's per-batch engine
         traffic (GraphExecutor::Forward/Backward + the kvstore push/pull +
         fused optimizer kernels, model.py:88-116) as ONE XLA program — no
         host dispatch per parameter, buffers reused in place via donation.
         Under an active mesh, ``shardings`` = (in_shardings, out_shardings)
-        lowers the single program SPMD-partitioned."""
+        lowers the single program SPMD-partitioned.
+
+        With ``guard`` (the training guardian's step guard) the program
+        also reduces ``isfinite`` over every gradient and output and
+        gates the param/state/aux update on the verdict: a non-finite
+        step is SKIPPED on device (old buffers selected) and the scalar
+        verdict comes back as a fifth result — one fused all-reduce, no
+        extra host round-trip.  Guard off returns a constant-true
+        verdict, which XLA folds away."""
         import jax
         import jax.numpy as jnp
 
@@ -497,7 +507,37 @@ class Executor:
                             lr0 * lmult, wd0 * wmult, t, keys.get(name))
                         new_params[name] = w
                         new_states[name] = s
-                    return list(outs), new_aux, new_params, new_states
+                    if guard:
+                        ok = jnp.bool_(True)
+                        sq = jnp.float32(0)
+                        for name, _idx, _, _ in update_infos:
+                            g = grads[name]
+                            ok &= jnp.all(jnp.isfinite(g))
+                            sq += jnp.sum(jnp.square(
+                                g.astype(jnp.float32)))
+                        for o in outs:
+                            if jnp.issubdtype(o.dtype, jnp.floating):
+                                ok &= jnp.all(jnp.isfinite(o))
+                        gnorm = jnp.sqrt(sq)
+                        # the f32 norm overflowing is itself an anomaly:
+                        # a single exponent bit-flip lands ~1e38 in a
+                        # gradient, which is finite but squares to inf —
+                        # catch it here, not N steps later in the spike
+                        # detector
+                        ok &= jnp.isfinite(gnorm)
+                        # on-device skip: a poisoned batch leaves params,
+                        # optimizer state and aux (BN stats) untouched
+                        sel = lambda new, old: jnp.where(ok, new, old)
+                        new_params = {k: sel(v, diff_args[k])
+                                      for k, v in new_params.items()}
+                        new_states = jax.tree_util.tree_map(
+                            sel, new_states, states)
+                        new_aux = jax.tree_util.tree_map(sel, new_aux, aux)
+                    else:
+                        ok = jnp.bool_(True)
+                        gnorm = jnp.float32(0)
+                    return (list(outs), new_aux, new_params, new_states,
+                            (ok, gnorm))
 
                 return fn
 
@@ -578,6 +618,12 @@ class Executor:
         t = optimizer.num_update
         lr0 = optimizer.lr_scheduler(t) if optimizer.lr_scheduler is not None \
             else optimizer.lr
+        from . import guardian as _guardian
+
+        if _guardian._governor is not None:
+            # re-warm ramp: lr rides in as a traced scalar, so the ramp
+            # never recompiles the fused program
+            lr0 *= _guardian.current_lr_mult()
         sc = (_np.float32(lr0), _np.float32(optimizer.wd), _np.int32(t))
 
         diff_args = {}
@@ -617,17 +663,22 @@ class Executor:
             (k, float(v)) for k, v in vars(optimizer).items()
             if isinstance(v, (int, float, bool)) and
             k not in ("num_update", "begin_num_update", "lr", "wd")))
+        # the guardian's step guard changes the compiled program (isfinite
+        # reduction + gated update), so it discriminates both cache keys
+        from . import guardian as _guardian
+
+        guard = _guardian.enabled()
         key = ("fused", tuple(infos), id(optimizer), type(optimizer).__name__,
                hypers, float(optimizer.rescale_grad),
                float(optimizer.clip_gradient or 0.0),
-               self._shard_fingerprint)
+               self._shard_fingerprint, guard)
         # the same key with every process-unstable part (object ids, shard
         # fingerprint — the compile cache derives a stable one from the
         # mesh itself) removed: what the persistent compile cache keys on
         stable_key = ("fused", tuple(infos), type(optimizer).__name__,
                       hypers, float(optimizer.rescale_grad),
                       float(optimizer.clip_gradient or 0.0),
-                      bool(optimizer.needs_rng))
+                      bool(optimizer.needs_rng), ("guard", int(guard)))
         first_build = key not in self._jit_cache
         shardings = None
         abstract_args = None
@@ -644,7 +695,8 @@ class Executor:
         fn = self._get_fused_step(key, tuple(infos), optimizer.pure_update,
                                   optimizer.needs_rng, shardings,
                                   stable_key=stable_key,
-                                  abstract_args=abstract_args)
+                                  abstract_args=abstract_args,
+                                  guard=guard)
         if first_build and not self._naive:
             # introspection hook (compile-miss path only — zero per-step
             # cost), so tools/perf_probe.py can lower/compile the exact
@@ -655,8 +707,12 @@ class Executor:
             # cost analysis per new executable, never per step
             self._fused_new_compile = True
         with _prof.Frame("Executor.fused_step", "exec"):
-            outs, new_aux, new_params, new_states = fn(
+            outs, new_aux, new_params, new_states, verdict = fn(
                 diff_args, states, aux, other_args, rng, sc, opt_rng)
+        # the on-device (ok, grad_norm) verdict: still device scalars —
+        # the guardian reads them where the step already syncs (metric
+        # update), so the guard adds no host round-trip of its own
+        self._guard_verdict = verdict if guard else None
         if first_build and not self._naive:
             # when the compile cache primed this executable, XLA's cost
             # analysis rode along (entry meta on hits, read once from the

@@ -40,7 +40,8 @@ from .plan import (FaultPlan, InjectedConnectionError, InjectedIOError, Rule,
 
 __all__ = ["FaultPlan", "Rule", "InjectedConnectionError", "InjectedIOError",
            "parse_spec", "install", "uninstall", "active", "fire",
-           "partial_fraction", "inject", "install_from_env"]
+           "partial_fraction", "corrupt", "targets_corruption", "inject",
+           "install_from_env"]
 
 _plan: Optional[FaultPlan] = None
 _lock = threading.Lock()
@@ -78,6 +79,24 @@ def partial_fraction(op: str) -> Optional[float]:
     if p is None:
         return None
     return p.partial_fraction(op)
+
+
+def corrupt(op: str, array):
+    """Tensor-corruption poll for array sites (``guardian.grad``, ...):
+    returns ``array`` untouched without an active plan, else whatever
+    :meth:`FaultPlan.corrupt` decides (a corrupted copy when a
+    ``nan``/``bitflip`` rule fires on this call)."""
+    p = _plan
+    if p is None:
+        return array
+    return p.corrupt(op, array)
+
+
+def targets_corruption(op: str) -> bool:
+    """True when the active plan has a corruption rule aimed at ``op``
+    (pure predicate — no counters advance)."""
+    p = _plan
+    return p is not None and p.targets_corruption(op)
 
 
 @contextlib.contextmanager

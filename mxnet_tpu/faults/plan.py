@@ -12,19 +12,23 @@ Spec grammar (one string, env-var friendly)::
     spec    := rule (";" rule)*
     rule    := op_glob ":" action ("," action)*
     action  := kind "=" rate ["@" param]
+             | kind ["@" param]          # rate-less shorthand, rate = 1
 
 * ``op_glob`` — fnmatch pattern over operation names (``kv.client.*``).
 * ``kind`` — one of ``drop`` (raise :class:`InjectedConnectionError`),
   ``ioerr`` (raise :class:`InjectedIOError`), ``delay`` (sleep),
   ``partial`` (torn file write — consumed by
   :func:`mxnet_tpu.filesystem.atomic_write`), ``kill``
-  (``os._exit(137)``, a hard crash no ``finally`` can intercept).
+  (``os._exit(137)``, a hard crash no ``finally`` can intercept),
+  ``nan``/``bitflip`` (tensor corruption — consumed by
+  :meth:`FaultPlan.corrupt` at instrumented tensor sites like
+  ``guardian.grad``).
 * ``rate`` — probability in [0, 1] drawn from the rule's own seeded
   stream, so unrelated rules never perturb each other's decisions.
 * ``param`` — kind-specific: delay duration (``10ms``/``0.25s``/bare
-  seconds), partial-write fraction kept, or — for any kind — ``#N`` to
-  fire exactly on the N-th matching call (deterministic count trigger;
-  rate is ignored).
+  seconds), partial-write fraction kept, bitflip bit index, or — for
+  any kind — ``#N`` to fire exactly on the N-th matching call
+  (deterministic count trigger; rate is ignored).
 
 Examples::
 
@@ -32,6 +36,8 @@ Examples::
     kv.client.recv:drop=1@#2             # drop exactly the 2nd ACK read
     ckpt.write:partial=1@0.5             # every save tears at 50%
     kv.server.recv:kill=1@#40;*:delay=0.05@5ms
+    guardian.grad:bitflip@#1             # flip a bit in the 1st guarded
+                                         # gradient (rate-less shorthand)
 
 Determinism contract: each rule owns a ``random.Random`` seeded from
 ``(seed, rule_index)`` and a call counter, so the decision for the N-th
@@ -50,7 +56,11 @@ from typing import Dict, List, Optional, Tuple
 __all__ = ["FaultPlan", "Rule", "InjectedConnectionError", "InjectedIOError",
            "parse_spec"]
 
-_KINDS = ("drop", "ioerr", "delay", "partial", "kill")
+_KINDS = ("drop", "ioerr", "delay", "partial", "kill", "nan", "bitflip")
+
+# kinds that are inert in fire() and polled by the instrumented tensor
+# site via FaultPlan.corrupt (the 'partial' pattern, but for arrays)
+_CORRUPT_KINDS = ("nan", "bitflip")
 
 
 class InjectedConnectionError(ConnectionResetError):
@@ -113,9 +123,13 @@ def parse_spec(spec: str) -> List[Rule]:
             action = action.strip()
             kind, sep, rest = action.partition("=")
             if not sep:
-                raise ValueError("bad fault action %r in rule %r"
-                                 % (action, chunk))
-            rate_s, _, param_s = rest.partition("@")
+                # rate-less shorthand 'kind[@param]' — rate defaults to 1
+                # (reads naturally with '#N' count triggers:
+                # 'guardian.grad:bitflip@#1')
+                kind, _, param_s = action.partition("@")
+                rate_s = "1"
+            else:
+                rate_s, _, param_s = rest.partition("@")
             param, nth = _parse_param(kind.strip(), param_s) if param_s \
                 else (None, None)
             rules.append(Rule(op.strip(), kind.strip(), float(rate_s),
@@ -210,7 +224,8 @@ class FaultPlan:
                 except Exception:
                     pass
                 os._exit(137)
-            # 'partial' intentionally inert in fire()
+            # 'partial' and the corrupt kinds intentionally inert in
+            # fire() — polled by their instrumented sites instead
 
     def partial_fraction(self, op: str) -> Optional[float]:
         """Fraction of the file to keep for a torn write at ``op``, or
@@ -234,3 +249,72 @@ class FaultPlan:
         if frac is not None:
             self._note_injected(op, "partial", hit_no)
         return frac
+
+    def targets_corruption(self, op: str) -> bool:
+        """True when any ``nan``/``bitflip`` rule's glob matches ``op``.
+        A pure predicate — counters and RNG streams are not advanced —
+        so callers can branch (e.g. the Module keeps gradients
+        host-visible for injection) without perturbing the schedule."""
+        return any(r.kind in _CORRUPT_KINDS and
+                   fnmatch.fnmatchcase(op, r.op) for r in self.rules)
+
+    def corrupt(self, op: str, array):
+        """Tensor-corruption poll for instrumented sites: returns
+        ``array`` untouched when no ``nan``/``bitflip`` rule fires on
+        this call, else a corrupted **copy**.
+
+        The victim element is picked from the rule's own seeded stream,
+        so which element is hit depends only on (spec, seed, call_no) —
+        the determinism contract the chaos scenarios replay against.
+
+        * ``nan`` — the picked element becomes NaN (for float dtypes;
+          integer arrays get their maximum value).
+        * ``bitflip`` — one bit of the picked element flips.  By default
+          the most-significant *exponent* bit (the canonical worst-case
+          SDC: the value scales by ~2**128 or collapses toward zero);
+          ``@B`` picks an explicit bit index instead.
+        """
+        hits = []
+        with self._lock:
+            for i, rule in enumerate(self.rules):
+                if rule.kind not in _CORRUPT_KINDS or \
+                        not fnmatch.fnmatchcase(op, rule.op):
+                    continue
+                self._counts[i] += 1
+                n = self._counts[i]
+                if rule.nth is not None:
+                    hit = (n == rule.nth)
+                else:
+                    hit = self._rngs[i].random() < rule.rate
+                if hit:
+                    self.events.append((op, rule.kind, n))
+                    # element pick drawn under the lock from the rule's
+                    # stream: stays deterministic per (spec, seed, N)
+                    hits.append((rule, n, self._rngs[i].randrange(2 ** 31)))
+        if not hits:
+            return array
+        import numpy as np
+
+        out = np.array(array, copy=True)
+        flat = out.reshape(-1).view()
+        for rule, n, pick in hits:
+            self._note_injected(op, rule.kind, n)
+            idx = pick % max(1, flat.size)
+            if rule.kind == "nan":
+                if np.issubdtype(out.dtype, np.floating):
+                    flat[idx] = np.nan
+                else:
+                    flat[idx] = np.iinfo(out.dtype).max
+            else:  # bitflip
+                itemsize = out.dtype.itemsize
+                bits = itemsize * 8
+                if rule.param is not None and rule.nth is None:
+                    bit = int(rule.param) % bits
+                elif np.issubdtype(out.dtype, np.floating) and bits >= 16:
+                    bit = bits - 2  # MSB of the exponent field
+                else:
+                    bit = bits - 1
+                u = np.dtype("uint%d" % bits)
+                word = flat[idx:idx + 1].view(u)
+                word ^= u.type(1) << u.type(bit)
+        return out

@@ -99,6 +99,20 @@ class DataIter:
     def getpad(self):
         raise NotImplementedError
 
+    # -- mid-epoch resume state (guardian rollback / deterministic replay) --
+    def state_dict(self) -> dict:
+        """Position snapshot (epoch cursor, shuffle order) as plain host
+        data.  Restoring it with :meth:`set_state` on an iterator built
+        from the same inputs replays the exact remaining batch sequence —
+        the contract guardian rollback and mid-epoch resume depend on."""
+        raise NotImplementedError(
+            "%s does not support state capture" % type(self).__name__)
+
+    def set_state(self, state: dict) -> None:
+        """Restore a :meth:`state_dict` snapshot."""
+        raise NotImplementedError(
+            "%s does not support state capture" % type(self).__name__)
+
 
 def _init_data(data, allow_empty, default_name):
     """Normalize input data to a list of (name, numpy) pairs (reference
@@ -143,6 +157,9 @@ class NDArrayIter(DataIter):
             np.random.shuffle(idx)
             self.data = [(k, v[idx]) for k, v in self.data]
             self.label = [(k, v[idx]) for k, v in self.label]
+            self._shuffle_perm = idx
+        else:
+            self._shuffle_perm = None
         self.idx = np.arange(self.num_data)
 
         # discard: drop the tail so every batch is full (static shapes — the
@@ -207,6 +224,26 @@ class NDArrayIter(DataIter):
             return self.cursor + self.batch_size - self.num_data
         return 0
 
+    def state_dict(self):
+        perm = self._shuffle_perm
+        return {"cursor": int(self.cursor),
+                "shuffle_perm": None if perm is None else perm.copy()}
+
+    def set_state(self, state):
+        perm = state.get("shuffle_perm")
+        if perm is not None:
+            perm = np.asarray(perm)
+            cur = self._shuffle_perm if self._shuffle_perm is not None \
+                else np.arange(len(perm))
+            if not np.array_equal(perm, cur):
+                # re-order through the original layout: undo this
+                # instance's own shuffle, then apply the saved one
+                inv = np.argsort(cur)
+                self.data = [(k, v[inv][perm]) for k, v in self.data]
+                self.label = [(k, v[inv][perm]) for k, v in self.label]
+                self._shuffle_perm = perm
+        self.cursor = int(state["cursor"])
+
 
 class ResizeIter(DataIter):
     """Resize another iterator to ``size`` batches per epoch (reference
@@ -251,6 +288,13 @@ class ResizeIter(DataIter):
     def getpad(self):
         return self.current_batch.pad
 
+    def state_dict(self):
+        return {"cur": int(self.cur), "inner": self.data_iter.state_dict()}
+
+    def set_state(self, state):
+        self.data_iter.set_state(state["inner"])
+        self.cur = int(state["cur"])
+
 
 #: queue sentinel marking a source iterator's end of epoch
 _END_OF_EPOCH = object()
@@ -293,6 +337,7 @@ class PrefetchingIter(DataIter):
         self._threads = []
         self._stop = None
         self._exhausted = False
+        self._consumed = 0  # batches the CONSUMER has popped this epoch
         self._spin_up()
 
     # -- pipeline lifecycle -------------------------------------------------
@@ -392,6 +437,33 @@ class PrefetchingIter(DataIter):
         for src in self.iters:
             src.reset()
         self._exhausted = False
+        self._consumed = 0
+        self._spin_up()
+
+    def state_dict(self):
+        """Forward to the wrapped iters, fixed up for prefetch depth: the
+        workers have already pulled ahead of the consumer, so the
+        captured position is the **consumed-batch** cursor, not the
+        source's read-ahead cursor.  Sources must expose a top-level
+        ``cursor`` (NDArrayIter/MNISTIter/CSVIter do); shuffle order
+        passes through untouched."""
+        states = []
+        for src in self.iters:
+            s = dict(src.state_dict())
+            if "cursor" not in s:
+                raise ValueError(
+                    "PrefetchingIter state capture needs cursor-based "
+                    "sources; %s has none" % type(src).__name__)
+            s["cursor"] = (self._consumed - 1) * src.batch_size
+            states.append(s)
+        return {"consumed": int(self._consumed), "sources": states}
+
+    def set_state(self, state):
+        self._tear_down()
+        for src, s in zip(self.iters, state["sources"]):
+            src.set_state(s)
+        self._consumed = int(state["consumed"])
+        self._exhausted = False
         self._spin_up()
 
     @staticmethod
@@ -441,6 +513,7 @@ class PrefetchingIter(DataIter):
             return False
         if m is not None:  # the end-of-epoch pop is not a batch
             m["batches"].inc()
+        self._consumed += 1
         first = parts[0]
         if any(p.pad != first.pad for p in parts):
             raise RuntimeError("prefetch sources disagree on batch padding")
@@ -571,6 +644,12 @@ class CSVIter(DataIter):
     def getpad(self):
         end = self.cursor + self.batch_size
         return max(0, end - self.num_data)
+
+    def state_dict(self):
+        return {"cursor": int(self.cursor)}
+
+    def set_state(self, state):
+        self.cursor = int(state["cursor"])
 
 
 def ImageRecordIter(**kwargs):

@@ -27,8 +27,20 @@ from .base import MXNetError, register_env
 from .ndarray import NDArray
 from . import ndarray as nd
 from . import optimizer as opt
+__all__ = ["KVStore", "create", "install_preemption_handler",
+           "NonFiniteGradientError"]
 
-__all__ = ["KVStore", "create", "install_preemption_handler"]
+
+def __getattr__(name):
+    # typed NACK for non-finite pushes (numeric containment) — re-exported
+    # here because workers catch it around push(), not around server code.
+    # Lazy: an eager import would run kvstore_server's DMLC_ROLE=server
+    # bootstrap earlier than the package __init__ sequences it.
+    if name == "NonFiniteGradientError":
+        from .kvstore_server import NonFiniteGradientError
+
+        return NonFiniteGradientError
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
 
 register_env("MXNET_KVSTORE_COMPRESS", "", str,
              "Wire compression for dist_async push payloads: 'fp16' halves "

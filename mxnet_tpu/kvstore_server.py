@@ -50,13 +50,15 @@ from . import ndarray as nd
 from . import optimizer as opt
 from . import profiler as _prof
 from . import telemetry as _telemetry
+from .base import MXNetError
 from .base import env as _env
 from .base import register_env
 from .sparse.array import row_merge
 from .telemetry import tracer
 
 __all__ = ["KVStoreServer", "start_server", "ServerClient",
-           "KVStoreConnectionError", "_init_kvstore_server_module"]
+           "KVStoreConnectionError", "NonFiniteGradientError",
+           "_init_kvstore_server_module"]
 
 register_env("MXNET_KVSTORE_RETRY_MAX", 10, int,
              "Max reconnect/replay attempts per kvstore client RPC.")
@@ -97,6 +99,16 @@ register_env("MXNET_TELEMETRY_STRAGGLER_MULT", 4.0, float,
 register_env("MXNET_TELEMETRY_STRAGGLER_MIN_MS", 50.0, float,
              "Minimum absolute sync-round latency (ms) before a rank can "
              "be flagged as a straggler — suppresses noise on fast rounds.")
+register_env("MXNET_KVSTORE_REJECT_NONFINITE", 1, int,
+             "Server-side numeric containment: reject dense/sparse "
+             "gradient pushes carrying NaN/Inf with a typed NACK instead "
+             "of merging them into the shared parameter plane.  0 "
+             "disables the scan.")
+register_env("MXNET_KVSTORE_NACK_LIMIT", 0, int,
+             "Non-finite push rejections tolerated per rank before the "
+             "server flags it as poisoned and evicts it from the elastic "
+             "membership (sync rounds re-form around the survivors).  0 "
+             "never evicts — pushes are still NACKed.")
 
 
 # -- retry/backoff knobs (docs/how_to/fault_tolerance.md) -------------------
@@ -135,6 +147,13 @@ class KVStoreConnectionError(ConnectionError):
     Subclasses ConnectionError, so existing transport handlers still
     catch it; callers that care (an evicted worker deciding to exit) can
     match the type."""
+
+
+class NonFiniteGradientError(MXNetError):
+    """The server NACKed this client's gradient push: it carried NaN/Inf
+    values and was never applied to the parameter plane.  Deliberately
+    NOT a ConnectionError — retrying the same payload cannot succeed;
+    the worker should drop the batch (or let its guardian respond)."""
 
 
 def _backoff_sleep(attempt, conf):
@@ -269,6 +288,10 @@ def _srv_metrics():
             "sparse_pulled": reg.counter(
                 "mxtpu_kvsrv_sparse_rows_pulled_total",
                 "Embedding-table rows served via pull_rows."),
+            "rejected": reg.labeled_counter(
+                "mxtpu_kvsrv_rejected_pushes_total", "rank",
+                "Gradient pushes NACKed for carrying non-finite values "
+                "(numeric containment — never applied to the store)."),
             # per-command latency histograms (incl. the membership RPCs
             # join/leave/evict/membership and the sparse push_rows/
             # pull_rows plane) and per-rank round-wait histograms, created
@@ -422,6 +445,10 @@ class KVStoreServer:
         self._dedup: Dict[str, dict] = {}
         self._dedup_cv = threading.Condition()
         self.applied_pushes = 0  # distinct (non-replayed) push applications
+        # numeric containment: non-finite pushes NACKed, total and per
+        # rank (chaos scenarios assert on these without telemetry)
+        self.rejected_pushes = 0
+        self.rejects_by_rank: Dict[int, int] = {}
         # contribution-count histogram of flushed sync-merge rounds
         # ({3: 40, 2: 7} = 40 full rounds, 7 renormalized 2-worker rounds);
         # chaos tests read it to prove shrink/grow actually changed round
@@ -620,6 +647,9 @@ class KVStoreServer:
         if cmd == "push":
             key, arr = msg[1], msg[2]
             rank = msg[3] if len(msg) > 3 else 0
+            nack = self._reject_nonfinite("push", key, arr, rank)
+            if nack is not None:
+                return nack
             with self._lock:
                 if self.sync_mode and self._members \
                         and rank not in self._members:
@@ -697,6 +727,9 @@ class KVStoreServer:
             faults.fire("kv.server.push_rows")
             key, row_ids, values = msg[1], msg[2], msg[3]
             rank = msg[4] if len(msg) > 4 else 0
+            nack = self._reject_nonfinite("push_rows", key, values, rank)
+            if nack is not None:
+                return nack
             with self._lock:
                 if key not in self.tables:
                     return ("err", "uninitialized table %r" % (key,))
@@ -963,6 +996,53 @@ class KVStoreServer:
                     "straggler", key=str(key), rank=r,
                     lat_ms=round(lat, 3), median_ms=round(med, 3),
                     mult=mult, round_size=len(tsr))
+
+    def _reject_nonfinite(self, cmd, key, values, rank):
+        """Numeric containment (guardian's fleet-side half): a gradient
+        push carrying NaN/Inf is answered with a typed NACK and never
+        touches the store/merge rounds — one poisoned worker cannot
+        corrupt the parameter plane every other rank pulls from.  Runs
+        BEFORE the dedup-recorded dispatch returns, so a retried push
+        replays the same NACK from the idempotency window without
+        double-counting.  Returns the NACK reply tuple, or None to admit
+        the push."""
+        if _env("MXNET_KVSTORE_REJECT_NONFINITE", 1, int) == 0:
+            return None
+        a = np.asarray(values)
+        if not np.issubdtype(a.dtype, np.floating) or \
+                bool(np.all(np.isfinite(a))):
+            return None
+        with self._lock:
+            self.rejected_pushes += 1
+            n = self.rejects_by_rank.get(rank, 0) + 1
+            self.rejects_by_rank[rank] = n
+        if _telemetry.enabled():
+            _srv_metrics()["rejected"].inc(str(rank))
+            _telemetry.log_event("kv_nack", cmd=cmd, key=str(key),
+                                 rank=rank, count=n)
+        limit = _env("MXNET_KVSTORE_NACK_LIMIT", 0, int)
+        if limit > 0 and n >= limit:
+            self._flag_poisoned(rank, n)
+        return ("nack", "nonfinite",
+                "%s from rank %s to key %r carries non-finite values "
+                "(rejection %d for this rank)" % (cmd, rank, key, n))
+
+    def _flag_poisoned(self, rank, n):
+        """A rank crossed MXNET_KVSTORE_NACK_LIMIT rejections: flag it
+        through the straggler counter (the fleet-health dashboard's
+        existing bad-rank signal) and, if it holds elastic membership,
+        evict it exactly like a heartbeat-dead rank."""
+        if _telemetry.enabled():
+            _srv_metrics()["stragglers"].inc(str(rank))
+            _telemetry.log_event("poisoned_worker", rank=rank,
+                                 rejections=n)
+        with self._lock:
+            member = bool(self._members) and rank in self._members
+        if member:
+            # established lock order: _barrier_cv before _lock
+            with self._barrier_cv:
+                self._evict_members_locked(
+                    [rank], "poisoned (%d non-finite pushes)" % n)
 
     def _try_release_barrier_locked(self):
         """Release the parked barrier if every required rank has arrived
@@ -1619,9 +1699,13 @@ class ServerClient:
         if ent["exc"] is not None:
             raise ent["exc"]
         reply = ent["reply"]
+        if reply[0] == "nack":
+            # typed rejection (numeric containment): retrying the same
+            # payload cannot succeed, so surface it as its own error
+            raise NonFiniteGradientError(
+                "kvstore server rejected push: %s"
+                % (reply[2] if len(reply) > 2 else reply[1],))
         if reply[0] != "ok":
-            from .base import MXNetError
-
             raise MXNetError("kvstore server error: %s" % (reply[1],))
         return reply[1] if len(reply) > 1 else None
 
@@ -1717,9 +1801,11 @@ class ServerClient:
         replies = self._rpc("multi", list(msgs))
         out = []
         for r in replies:
+            if r[0] == "nack":
+                raise NonFiniteGradientError(
+                    "kvstore server rejected push: %s"
+                    % (r[2] if len(r) > 2 else r[1],))
             if r[0] != "ok":
-                from .base import MXNetError
-
                 raise MXNetError("kvstore server error: %s" % (r[1],))
             out.append(r[1] if len(r) > 1 else None)
         return out

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 from typing import Dict, Optional, Tuple
 
 from . import ndarray as nd
@@ -17,7 +18,7 @@ from .base import MXNetError
 from . import kvstore as kvs
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "FeedForward"]
+           "load_checkpoint_state", "FeedForward"]
 
 BatchEndParam = collections.namedtuple(
     "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
@@ -115,21 +116,116 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             updater(index * num_device + k, g, w)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    max_to_keep=None, extra_state=None,
+                    mark_last_good=False):
     """Write prefix-symbol.json + prefix-%04d.params (reference
     model.py:319-349; format per ndarray.cc:633-714).
 
     Both files land atomically (tmp + fsync + ``os.replace``) and the
     params file carries a CRC32 sidecar, so a crash mid-save can neither
     tear the newest checkpoint nor shadow the previous good one, and
-    :func:`find_latest_checkpoint` can reject corrupted survivors."""
+    :func:`find_latest_checkpoint` can reject corrupted survivors.
+
+    Alongside the params a ``prefix-%04d.state`` sidecar captures the
+    framework PRNG stream (``mx.random.get_state()``) merged with any
+    caller ``extra_state`` (e.g. data-iterator position from
+    ``DataIter.state_dict()``), closing the deterministic-replay gap: a
+    resume that restores the sidecar replays the exact stochastic
+    schedule and batch sequence the original run would have seen.
+
+    ``max_to_keep`` prunes the retention ring down to the newest N
+    epochs after the new one lands (the ``last_good``-marked epoch is
+    never pruned); ``mark_last_good`` stamps this epoch as the rollback
+    target :func:`find_latest_checkpoint` prefers."""
+    import pickle
+
+    from . import random as _random
+    from .filesystem import atomic_write
+
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
     nd.save(param_name, save_dict, checksum=True, op="ckpt.write")
+    state = {"rng": _random.get_state()}
+    if extra_state:
+        state.update(extra_state)
+    blob = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+    atomic_write("%s-%04d.state" % (prefix, epoch),
+                 lambda f: f.write(blob), checksum=False, op="ckpt.state")
+    if mark_last_good:
+        _mark_last_good(prefix, epoch)
+    if max_to_keep is not None:
+        _prune_checkpoints(prefix, int(max_to_keep))
     logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def _last_good_path(prefix):
+    return "%s-last-good" % prefix
+
+
+def _mark_last_good(prefix, epoch):
+    """Atomically stamp ``epoch`` as the rollback target for ``prefix``."""
+    from .filesystem import atomic_write
+
+    atomic_write(_last_good_path(prefix),
+                 lambda f: f.write(("%04d\n" % epoch).encode("ascii")),
+                 checksum=False, op="ckpt.state")
+
+
+def _read_last_good(prefix):
+    """Epoch stamped by :func:`_mark_last_good`, or None."""
+    try:
+        with open(_last_good_path(prefix), "r") as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def _prune_checkpoints(prefix, max_to_keep):
+    """Delete all but the newest ``max_to_keep`` epochs of ``prefix``
+    (params + CRC + state sidecars).  The ``last_good``-marked epoch is
+    exempt — pruning must never delete the rollback target."""
+    import glob
+    import re
+
+    if max_to_keep < 1:
+        return
+    keep_always = _read_last_good(prefix)
+    epochs = []
+    for path in glob.glob("%s-[0-9][0-9][0-9][0-9].params" % prefix):
+        m = re.search(r"-(\d{4})\.params$", path)
+        if m:
+            epochs.append(int(m.group(1)))
+    for ep in sorted(epochs, reverse=True)[max_to_keep:]:
+        if ep == keep_always:
+            continue
+        for suffix in (".params", ".params.crc32", ".state"):
+            try:
+                os.remove("%s-%04d%s" % (prefix, ep, suffix))
+            except OSError:
+                pass
+
+
+def load_checkpoint_state(prefix, epoch, restore_rng=False):
+    """Read the ``.state`` sidecar written by :func:`save_checkpoint`
+    (None for a pre-sidecar checkpoint).  ``restore_rng`` feeds the
+    captured PRNG stream straight back into ``mx.random`` so the resumed
+    run continues the original stochastic schedule bit-exactly."""
+    import pickle
+
+    from . import random as _random
+
+    try:
+        with open("%s-%04d.state" % (prefix, epoch), "rb") as f:
+            state = pickle.load(f)
+    except OSError:
+        return None
+    if restore_rng and "rng" in state:
+        _random.set_state(state["rng"])
+    return state
 
 
 def _checkpoint_ok(path):
@@ -152,7 +248,7 @@ def _checkpoint_ok(path):
         return False
 
 
-def find_latest_checkpoint(prefix):
+def find_latest_checkpoint(prefix, prefer_last_good=True):
     """Newest saved epoch for ``prefix`` (prefix-%04d.params), or None.
 
     The discovery half of checkpoint-based fault tolerance: a relaunched
@@ -161,10 +257,21 @@ def find_latest_checkpoint(prefix):
     --load-epoch; the launcher's --auto-resume mode relies on this).
     Partial or corrupt files (CRC sidecar mismatch, bad container magic)
     are skipped, so a crash during save rolls resume back to the newest
-    INTACT epoch instead of wedging every relaunch on a torn file."""
+    INTACT epoch instead of wedging every relaunch on a torn file.
+
+    When the training guardian has stamped a ``last_good`` marker
+    (``prefix-last-good``), that epoch wins over anything newer: epochs
+    past the marker may carry numerically-poisoned parameters the
+    guardian was rolling away from when the process died.  Pass
+    ``prefer_last_good=False`` for the raw newest-intact scan."""
     import glob
     import re
 
+    if prefer_last_good:
+        marked = _read_last_good(prefix)
+        if marked is not None and \
+                _checkpoint_ok("%s-%04d.params" % (prefix, marked)):
+            return marked
     best = None
     for path in glob.glob("%s-[0-9][0-9][0-9][0-9].params" % prefix):
         m = re.search(r"-(\d{4})\.params$", path)

@@ -61,6 +61,8 @@ class BaseModule:
         self.optimizer_initialized = False
         self._symbol = None
         self._total_exec_bytes = 0
+        self._guardian = None  # attached by fit() when MXNET_GUARDIAN=1
+        self._guardian_action = "ok"  # last update()'s verdict
 
     # ------------------------------------------------------------------
     # high-level interface
@@ -177,7 +179,12 @@ class BaseModule:
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        from .. import guardian as _guardian_mod
         from .. import profiler as _prof
+
+        guardian = _guardian_mod.Guardian() if _guardian_mod.enabled() \
+            else None
+        self._guardian = guardian
 
         # ------------------------------------------------------ training loop
         try:
@@ -190,6 +197,23 @@ class BaseModule:
                 nbatch = 0
                 with _prof.Frame("Module.fit:epoch%d" % epoch, "fit"):
                     while True:
+                        # the iterator cursor must be captured BEFORE the
+                        # fetch (so a rollback replays the batch about to
+                        # run) but the snapshot is only committed after the
+                        # fetch succeeds — a cursor parked on StopIteration
+                        # would make the replayed epoch end early.  Forced
+                        # at each epoch start: replaying across an epoch
+                        # boundary would re-apply the prior epoch's tail.
+                        snap_force = guardian is not None and nbatch == 0
+                        snap_due = guardian is not None and \
+                            (snap_force or guardian.snapshot_due())
+                        snap_iter = None
+                        if snap_due:
+                            try:
+                                snap_iter = train_data.state_dict()
+                            except (NotImplementedError, ValueError,
+                                    AttributeError):
+                                pass  # replay falls back to live position
                         # data-wait: time blocked on the iterator (the
                         # prefetch pipeline's starvation signal) — measured
                         # only when telemetry is on so the off path stays
@@ -209,11 +233,25 @@ class BaseModule:
                                 data_batch = next(data_iter)
                             except StopIteration:
                                 break
+                        if snap_due:
+                            self._guardian_snapshot(guardian, snap_iter,
+                                                    epoch, nbatch,
+                                                    force=snap_force)
                         if monitor is not None:
                             monitor.tic()
                         with _prof.Frame("Module.fit:step", "fit"):
                             self.forward_backward(data_batch)
                             self.update()
+                        if guardian is not None and \
+                                self._guardian_action == "rollback":
+                            # restore the last-good snapshot and replay —
+                            # with params/updater/PRNG/iterator all rolled
+                            # back, the replayed steps are bit-identical to
+                            # what an uncorrupted run would have produced
+                            nbatch = self._guardian_rollback(guardian,
+                                                             train_data,
+                                                             epoch)
+                            continue
                         # on an async kvstore update() leaves comms in
                         # flight; metric update + the iterator's next-batch
                         # prefetch run inside that window, and the next
@@ -260,6 +298,67 @@ class BaseModule:
             close = getattr(train_data, "close", None)
             if callable(close):
                 close()
+
+    # ------------------------------------------------------------------
+    # guardian: last-good retention ring + rollback-and-replay
+    # ------------------------------------------------------------------
+    def _guardian_snapshot(self, guardian, iter_state, epoch, nbatch,
+                           force=False):
+        """Offer a last-good ring snapshot before this batch runs
+        (``iter_state`` was captured before the fetch, so it replays
+        this very batch).  The capture closure only executes on the
+        batches the guardian elects (every MXNET_GUARDIAN_SNAPSHOT_EVERY
+        applied steps plus each epoch start, never while anomalies are
+        live) — it copies every parameter."""
+
+        def capture():
+            from .. import random as _random
+
+            arg_params, aux_params = self.get_params()
+            snap = {"arg": {k: v.copy() for k, v in arg_params.items()},
+                    "aux": {k: v.copy() for k, v in aux_params.items()},
+                    "rng": _random.get_state(),
+                    "epoch": epoch, "nbatch": nbatch,
+                    "updater": None, "iter": iter_state}
+            upd = getattr(self, "_updater", None)
+            if upd is not None:
+                snap["updater"] = upd.get_states()
+            return snap
+
+        guardian.offer_snapshot(capture, force=force)
+
+    def _guardian_rollback(self, guardian, train_data, epoch):
+        """Restore the newest ring snapshot from the current epoch —
+        params, updater state, the framework PRNG stream, and the
+        data-iterator position — so the fit loop replays from last-good.
+        Returns the restored nbatch.  Raises GuardianAbort when the
+        rollback budget is spent or no in-epoch snapshot was retained
+        (fit forces one at each epoch start, so only a ring-size of
+        zero or an unseeded resume can hit that)."""
+        from .. import guardian as _guardian_mod
+        from .. import random as _random
+
+        target = guardian.rollback_target(
+            lambda snap: snap.get("epoch") == epoch)
+        guardian.note_rollback(
+            to_step=target[0] if target is not None else None)
+        if target is None:
+            raise _guardian_mod.GuardianAbort(
+                "guardian must roll back but the last-good ring holds no "
+                "snapshot from the current epoch")
+        snap = target[1]
+        self.set_params(snap["arg"], snap["aux"])
+        upd = getattr(self, "_updater", None)
+        if upd is not None and snap["updater"] is not None:
+            upd.set_states(snap["updater"])
+        _random.set_state(snap["rng"])
+        if snap["iter"] is not None:
+            train_data.set_state(snap["iter"])
+        self._guardian_action = "ok"
+        self.logger.info(
+            "guardian: rolled back to last-good snapshot "
+            "(epoch %d, batch %d)", snap["epoch"], snap["nbatch"])
+        return snap["nbatch"]
 
     # ------------------------------------------------------------------
     # symbol / params

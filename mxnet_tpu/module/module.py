@@ -435,6 +435,12 @@ class Module(BaseModule):
 
         if env("MXNET_FUSED_STEP", "1", str) == "0":
             return False
+        from .. import faults as _faults
+        if _faults.targets_corruption("guardian.grad"):
+            # scheduled gradient corruption (nan/bitflip fault injection)
+            # rewrites host-visible grad buffers; the fused step never
+            # materializes them, so fall back to the eager loop
+            return False
         if self._update_on_kvstore or self._updater is None:
             return False
         if self._kvstore is not None and "dist" in self._kvstore.type \
@@ -530,12 +536,27 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        self._guardian_action = "ok"
         if self._fused_pending is not None:
             batch, self._fused_pending = self._fused_pending, None
             self._exec_group.fused_step(batch, self._optimizer, self._updater)
+            g = getattr(self, "_guardian", None)
+            if g is not None and self._exec_group.execs:
+                # the on-device guard already gated the poisoned update out
+                # with a where(); this read lands where the step syncs
+                # anyway (metric update) and only feeds the response ladder
+                verdict = getattr(self._exec_group.execs[0],
+                                  "_guard_verdict", None)
+                if verdict is not None:
+                    ok, gnorm = verdict
+                    self._guardian_action = g.observe(finite=bool(ok),
+                                                      gnorm=float(gnorm))
             if _telemetry.enabled():
                 self._telemetry_step_end()
             return
+        from .. import faults as _faults
+        if _faults.targets_corruption("guardian.grad"):
+            self._corrupt_grads()
         if self._update_on_kvstore:
             # pushes go out in backward order (the order grads become
             # available) with priority=-index; the wait is deferred so an
@@ -554,6 +575,12 @@ class Module(BaseModule):
             kv = self._kvstore
             if kv is not None and self._exec_group._multiprocess:
                 kv = None
+            if self._guardian_observe_eager() != "ok":
+                # anomalous batch: leave params/updater state untouched —
+                # the eager-path equivalent of the fused guard's where()
+                if _telemetry.enabled():
+                    self._telemetry_step_end()
+                return
             _update_params(self._exec_group.param_arrays,
                            self._exec_group.grad_arrays,
                            updater=self._updater,
@@ -561,6 +588,50 @@ class Module(BaseModule):
                            kvstore=kv)
         if _telemetry.enabled():
             self._telemetry_step_end()
+
+    def _each_grad(self):
+        for arr in self._exec_group.grad_arrays:
+            for a in (arr if isinstance(arr, list) else [arr]):
+                if a is not None:
+                    yield a
+
+    def _corrupt_grads(self):
+        """Run every host-visible gradient past the fault plan's corrupt
+        hook (nan/bitflip kinds on the ``guardian.grad`` op); an armed rule
+        rewrites the chosen element in place.  Only reached when a plan
+        actually targets corruption (update() pre-checks), so the normal
+        path never pays the host transfer."""
+        from .. import faults as _faults
+
+        for a in self._each_grad():
+            before = a.asnumpy()
+            after = _faults.corrupt("guardian.grad", before)
+            if after is not before:
+                a[:] = after
+
+    def _guardian_observe_eager(self):
+        """Host-side guard for the eager update path: finiteness + global
+        grad-norm over every gradient, fed to the guardian's response
+        ladder.  Returns the action ("ok" = apply this batch)."""
+        g = getattr(self, "_guardian", None)
+        if g is None:
+            return "ok"
+        finite = True
+        # accumulate the norm in f32, matching the fused guard: a
+        # finite-but-huge corruption (exponent bit-flip ~1e38) overflows
+        # the square-sum and reads as non-finite right here, with no
+        # spike history needed
+        sq = np.float32(0)
+        with np.errstate(over="ignore"):  # overflow IS the signal
+            for a in self._each_grad():
+                v = np.asarray(a.asnumpy(), dtype=np.float32)
+                if not np.all(np.isfinite(v)):
+                    finite = False
+                    break
+                sq += np.sum(np.square(v))
+        gnorm = float(np.sqrt(sq)) if finite else float("inf")
+        self._guardian_action = g.observe(finite=finite, gnorm=gnorm)
+        return self._guardian_action
 
     def _telemetry_step_end(self):
         """Close the step span: batch size, wall time, and — on the fused

@@ -18,6 +18,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from .ndarray import NDArray
+from . import guardian as _guardian
 from . import ndarray as nd
 
 
@@ -180,6 +181,10 @@ class Optimizer:
             lr *= self.lr_mult[index]
         elif index in self.idx2name:
             lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        if _guardian._governor is not None:
+            # guardian re-warm ramp after an anomaly burst; a plain
+            # None-check when no ramp is live
+            lr *= _guardian.current_lr_mult()
         return lr
 
     def _get_wd(self, index):

@@ -11,7 +11,8 @@ import threading
 
 import numpy as np
 
-__all__ = ["seed", "next_key", "uniform", "normal", "randint", "current_seed"]
+__all__ = ["seed", "next_key", "uniform", "normal", "randint", "current_seed",
+           "get_state", "set_state"]
 
 _state = threading.local()
 
@@ -38,6 +39,25 @@ def seed(seed_state: int) -> None:
 
 def current_seed() -> int:
     return _ensure().seed
+
+
+def get_state() -> dict:
+    """Snapshot the framework PRNG stream as plain host data (the key as
+    a numpy array), so checkpoints can carry it — the missing half of
+    deterministic resume: params alone replay a different stochastic
+    schedule."""
+    st = _ensure()
+    return {"seed": st.seed, "key": np.asarray(st.key).copy()}
+
+
+def set_state(state: dict) -> None:
+    """Restore a :func:`get_state` snapshot: the next :func:`next_key`
+    split continues bit-identically from the captured stream position."""
+    import jax.numpy as jnp
+
+    st = _ensure()
+    st.seed = int(state["seed"])
+    st.key = jnp.asarray(np.asarray(state["key"]))
 
 
 def next_key():

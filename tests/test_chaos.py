@@ -218,3 +218,19 @@ def test_periodic_snapshot_thread_writes_without_traffic(tmp_path):
     from mxnet_tpu.filesystem import verify_crc_sidecar
 
     assert verify_crc_sidecar(snap) is True
+
+
+def test_sdc_rollback_scenario_replays_bit_identical():
+    """The guardian acceptance scenario end to end: a seeded exponent
+    bit-flip in one gradient tensor is detected by the step guard, the
+    fit rolls back to the last-good ring snapshot (params + updater +
+    PRNG + iterator cursor) and replays to a final state bit-identical
+    to an uninjected control run; a NaN-poisoned kvstore push is NACKed
+    server-side and never applied.  Replay other schedules with
+    ``python tools/chaos_run.py --scenario sdc-rollback --seeds 0:N``."""
+    tools = os.path.join(ROOT, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    from chaos_run import run_sdc_rollback
+
+    assert run_sdc_rollback(seed=0, timeout=110.0)

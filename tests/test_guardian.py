@@ -1,0 +1,369 @@
+"""Training guardian: numeric-anomaly detection, graded response
+(skip -> LR re-warm -> rollback), last-good retention ring, the fused
+on-device step guard, and the kvstore server's non-finite push NACK.
+
+The end-to-end rollback-and-replay bit-identity proof lives in
+tests/test_chaos.py (sdc-rollback) so it rides the chaos marker; this
+file covers the units and the cheap integration seams.
+"""
+import os
+import threading
+import time
+import timeit
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, guardian
+from mxnet_tpu import kvstore_server as kvs
+
+
+@pytest.fixture(autouse=True)
+def _guardian_clean():
+    """Every test starts and ends with the guardian off and zeroed."""
+    guardian.disable()
+    guardian.reset_stats()
+    yield
+    faults.uninstall()
+    guardian.disable()
+    guardian.reset_stats()
+
+
+def _fake_clock(start=100.0):
+    state = {"t": start}
+
+    def clock():
+        state["t"] += 1.0
+        return state["t"]
+
+    return clock
+
+
+# ---------------------------------------------------------------------------
+# the ladder (pure unit, fake clock)
+# ---------------------------------------------------------------------------
+def test_ladder_skip_rewarm_rollback_sequence():
+    g = guardian.Guardian(clock=_fake_clock(), skip_max=2, rewarm_steps=10,
+                          rewarm_factor=0.1, rollback_max=5, warmup=4)
+    actions = [g.observe(finite=False) for _ in range(6)]
+    # consec 1..2 skip, 3 starts the re-warm rung, 4..5 skip under the
+    # fresh ramp, 6 exhausts the ladder
+    assert actions == ["skip", "skip", "rewarm", "skip", "skip", "rollback"]
+
+
+def test_clean_step_resets_the_ladder():
+    g = guardian.Guardian(clock=_fake_clock(), skip_max=1, rewarm_steps=0,
+                          warmup=2)
+    assert g.observe(finite=False) == "skip"
+    assert g.observe(finite=True, gnorm=1.0) == "ok"
+    # consecutive count reset: the next anomaly is a fresh skip, not an
+    # escalation
+    assert g.observe(finite=False) == "skip"
+    assert g.observe(finite=False) == "rollback"  # rewarm rung removed
+
+
+def test_immediate_rollback_when_skip_and_rewarm_disabled():
+    g = guardian.Guardian(clock=_fake_clock(), skip_max=0, rewarm_steps=0)
+    assert g.observe(finite=False) == "rollback"
+
+
+def test_nonfinite_gnorm_or_loss_is_an_anomaly():
+    g = guardian.Guardian(clock=_fake_clock(), skip_max=1, warmup=2)
+    assert g.observe(finite=True, gnorm=float("inf")) == "skip"
+    g2 = guardian.Guardian(clock=_fake_clock(), skip_max=1, warmup=2)
+    assert g2.observe(finite=True, gnorm=1.0, loss=float("nan")) == "skip"
+
+
+def test_spike_detector_arms_after_warmup():
+    g = guardian.Guardian(clock=_fake_clock(), skip_max=3, warmup=4,
+                          spike_mult=10.0, spike_window=8)
+    # before warmup history exists even a huge norm passes
+    assert g.observe(finite=True, gnorm=1000.0) == "ok"
+    for _ in range(4):
+        assert g.observe(finite=True, gnorm=1.0) == "ok"
+    # 1000 > 10x the rolling median -> grad_spike anomaly
+    assert g.observe(finite=True, gnorm=1000.0) == "skip"
+    # a clean value still passes and the spike was NOT added to history
+    assert g.observe(finite=True, gnorm=2.0) == "ok"
+    st = guardian.stats()
+    assert st["anomalies"] == 1 and st["skips"] == 1
+
+
+def test_rewarm_ramp_multiplier_and_governor():
+    g = guardian.Guardian(clock=_fake_clock(), skip_max=0, rewarm_steps=4,
+                          rewarm_factor=0.25, rollback_max=5, warmup=2)
+    assert g.lr_mult() == 1.0
+    assert guardian.current_lr_mult() == 1.0
+    assert g.observe(finite=False) == "rewarm"
+    assert g.lr_mult() == pytest.approx(0.25)
+    # the module-global governor now points at this ramp
+    assert guardian.current_lr_mult() == pytest.approx(0.25)
+    mults = []
+    for _ in range(4):
+        assert g.observe(finite=True, gnorm=1.0) == "ok"
+        mults.append(g.lr_mult())
+    assert mults == sorted(mults)  # monotone ramp up
+    assert mults[-1] == pytest.approx(1.0)
+    assert guardian.current_lr_mult() == 1.0  # governor released
+
+
+def test_rollback_budget_exhaustion_raises():
+    g = guardian.Guardian(clock=_fake_clock(), rollback_max=2)
+    g.note_rollback(to_step=0)
+    g.note_rollback(to_step=0)
+    with pytest.raises(guardian.GuardianAbort):
+        g.note_rollback(to_step=0)
+    assert guardian.stats()["rollbacks"] == 3
+
+
+# ---------------------------------------------------------------------------
+# the last-good retention ring
+# ---------------------------------------------------------------------------
+def test_snapshot_ring_retention_and_dedupe():
+    g = guardian.Guardian(clock=_fake_clock(), ring=2, snapshot_every=2,
+                          skip_max=2, warmup=2)
+    calls = []
+
+    def capture_at(tag):
+        def capture():
+            calls.append(tag)
+            return {"tag": tag}
+        return capture
+
+    assert g.snapshot_due()  # step 0 always qualifies
+    assert g.offer_snapshot(capture_at("s0"))
+    # same step again (a path that never observes): refused, captured once
+    assert not g.offer_snapshot(capture_at("dup"))
+    assert calls == ["s0"]
+
+    g.observe(finite=True, gnorm=1.0)  # step 1
+    assert not g.offer_snapshot(capture_at("odd"))  # not due, no force
+    assert g.offer_snapshot(capture_at("forced"), force=True)
+    g.observe(finite=True, gnorm=1.0)  # step 2
+    assert g.offer_snapshot(capture_at("s2"))
+    # ring keeps the newest 2 of the 3 retained
+    assert [s for s, _ in g._ring] == [1, 2]
+    assert g.rollback_target()[1]["tag"] == "s2"
+    assert guardian.stats()["snapshots"] == 3
+
+
+def test_snapshot_refused_while_anomalies_live():
+    g = guardian.Guardian(clock=_fake_clock(), ring=2, snapshot_every=1,
+                          skip_max=5, warmup=2)
+    g.observe(finite=False)  # live anomaly
+    assert not g.offer_snapshot(lambda: {"bad": True}, force=True)
+
+
+def test_rollback_target_match_filter():
+    g = guardian.Guardian(clock=_fake_clock(), ring=4, snapshot_every=1,
+                          warmup=2)
+    g.offer_snapshot(lambda: {"epoch": 0})
+    g.observe(finite=True, gnorm=1.0)
+    g.offer_snapshot(lambda: {"epoch": 1})
+    step, snap = g.rollback_target(lambda s: s["epoch"] == 0)
+    assert (step, snap["epoch"]) == (0, 0)
+    assert g.rollback_target(lambda s: s["epoch"] == 9) is None
+    assert g.rollback_target()[1]["epoch"] == 1
+
+
+# ---------------------------------------------------------------------------
+# module integration: the step guard gates poisoned updates out
+# ---------------------------------------------------------------------------
+def _small_module(fused):
+    os.environ["MXNET_FUSED_STEP"] = "1" if fused else "0"
+    try:
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net, context=mx.cpu())
+        mod.bind(data_shapes=[("data", (4, 6))],
+                 label_shapes=[("softmax_label", (4,))])
+        mx.random.seed(0)
+        np.random.seed(0)
+        mod.init_params(initializer=mx.init.Xavier(), force_init=True)
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1},
+                           force_init=True)
+    finally:
+        os.environ.pop("MXNET_FUSED_STEP", None)
+    return mod
+
+
+def _step(mod, x):
+    y = mx.nd.array(np.zeros(4, dtype=np.float32))
+    mod.forward_backward(mx.io.DataBatch(data=[mx.nd.array(x)], label=[y],
+                                         pad=0))
+    mod.update()
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_step_guard_skips_poisoned_update(fused):
+    guardian.enable()
+    mod = _small_module(fused)
+    if fused:
+        assert mod._fused_ok
+    mod._guardian = guardian.Guardian(clock=_fake_clock(), skip_max=2,
+                                      warmup=4)
+
+    clean = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+    _step(mod, clean)
+    assert mod._guardian_action == "ok"
+    before = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    poisoned = clean.copy()
+    poisoned[0, 0] = np.nan  # NaN propagates into every gradient
+    _step(mod, poisoned)
+    assert mod._guardian_action == "skip"
+    after = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    for k in before:
+        assert np.array_equal(before[k], after[k]), \
+            "%s changed across a skipped batch" % k
+
+    # training continues: the next clean step applies
+    _step(mod, clean)
+    assert mod._guardian_action == "ok"
+    final = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    assert any(not np.array_equal(before[k], final[k]) for k in before)
+
+
+def test_injected_nan_fault_detected_on_eager_path():
+    """The new ``nan`` corruption kind on ``guardian.grad``: the grads are
+    rewritten between backward and update, the guard answers skip."""
+    guardian.enable()
+    faults.install(faults.FaultPlan("guardian.grad:nan@#1", seed=0))
+    mod = _small_module(fused=True)  # corruption hook forces eager anyway
+    assert not mod._fused_ok, \
+        "scheduled guardian.grad corruption must fall back to eager"
+    mod._guardian = guardian.Guardian(clock=_fake_clock(), skip_max=2,
+                                      warmup=4)
+    before = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    _step(mod, np.random.RandomState(1).randn(4, 6).astype(np.float32))
+    assert mod._guardian_action == "skip"
+    after = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    for k in before:
+        assert np.array_equal(before[k], after[k])
+    assert guardian.stats()["anomalies"] == 1
+
+
+# ---------------------------------------------------------------------------
+# kvstore server: non-finite pushes are NACKed, never applied
+# ---------------------------------------------------------------------------
+def _server_pair():
+    srv = kvs.KVStoreServer(num_workers=1)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, kvs.ServerClient(*srv.addr)
+
+
+def test_nonfinite_dense_push_nacked_and_not_applied():
+    srv, cli = _server_pair()
+    try:
+        cli.init(0, np.zeros(4, dtype=np.float32))
+        cli.push(0, np.ones(4, dtype=np.float32), rank=0)
+        want = cli.pull(0).tobytes()
+        bad = np.ones(4, dtype=np.float32)
+        bad[2] = np.nan
+        with pytest.raises(kvs.NonFiniteGradientError):
+            cli.push(0, bad, rank=3)
+        assert cli.pull(0).tobytes() == want
+        assert srv.rejected_pushes == 1
+        assert srv.rejects_by_rank == {3: 1}
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_nonfinite_sparse_push_nacked():
+    srv, cli = _server_pair()
+    try:
+        cli.init_table("emb", {"num_rows": 8, "row_shape": (2,),
+                               "init": ("zeros",), "dtype": "float32",
+                               "num_servers": 1, "server_index": 0})
+        with pytest.raises(kvs.NonFiniteGradientError):
+            cli.push_rows("emb", np.array([1], dtype=np.int64),
+                          np.full((1, 2), np.inf, dtype=np.float32), rank=5)
+        rows = cli.pull_rows("emb", np.array([1], dtype=np.int64))
+        assert not rows.any(), "NACKed sparse push reached the table"
+        assert srv.rejects_by_rank == {5: 1}
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_nack_is_exactly_once_under_retry():
+    """A replayed envelope (same cid, seq) answers from the dedup window:
+    the recorded NACK comes back, the rejection is not double-counted."""
+    srv, cli = _server_pair()
+    try:
+        bad = np.full(4, np.nan, dtype=np.float32)
+        r1 = srv._serve_one("cidX", 7, ("push", 0, bad, 9))
+        r2 = srv._serve_one("cidX", 7, ("push", 0, bad, 9))
+        assert r1[0] == "nack" and r2 == r1
+        assert srv.rejects_by_rank == {9: 1}
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_nack_gate_can_be_disabled(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_REJECT_NONFINITE", "0")
+    srv, cli = _server_pair()
+    try:
+        cli.init(0, np.zeros(2, dtype=np.float32))
+        cli.push(0, np.full(2, np.nan, dtype=np.float32), rank=0)  # no raise
+        assert np.isnan(cli.pull(0)).all()
+        assert srv.rejected_pushes == 0
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_repeat_offender_evicted_at_nack_limit(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_NACK_LIMIT", "2")
+    srv, cli = _server_pair()
+    try:
+        cli.init(0, np.zeros(2, dtype=np.float32))
+        with srv._lock:
+            srv._members.update({3, 4})
+        bad = np.full(2, np.inf, dtype=np.float32)
+        for _ in range(2):
+            with pytest.raises(kvs.NonFiniteGradientError):
+                cli.push(0, bad, rank=3)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with srv._lock:
+                if 3 not in srv._members:
+                    break
+            time.sleep(0.01)
+        with srv._lock:
+            assert 3 not in srv._members, "poisoned rank not evicted"
+            assert 4 in srv._members
+    finally:
+        cli.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# overhead guard: guardian off must stay near-free
+# ---------------------------------------------------------------------------
+def test_disabled_overhead_under_two_percent():
+    """Off, each hook site costs one module-global bool read.  Budget:
+    ~8 hook reads per step must stay under 2% of even a tiny CPU step."""
+    assert not guardian.enabled()
+    mod = _small_module(fused=False)
+    assert mod._guardian is None
+
+    n = 200_000
+    per_gate_s = timeit.timeit(guardian.enabled, number=n) / n
+
+    x = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+    _step(mod, x)  # warm the compile caches
+    t0 = time.perf_counter()
+    for _ in range(20):
+        _step(mod, x)
+    step_s = (time.perf_counter() - t0) / 20
+
+    hooks_per_step = 8  # fit snapshot gate + update guard + eager observe
+    assert per_gate_s * hooks_per_step < 0.02 * step_s, \
+        "guardian-off gate cost %.3fus x %d vs step %.1fus" % (
+            per_gate_s * 1e6, hooks_per_step, step_s * 1e6)

@@ -35,6 +35,13 @@ checkpoint hot-swap through the fleet — asserting zero failed client
 requests, breaker open -> half-open -> closed, and zero post-warmup
 recompiles.
 
+``sdc-rollback`` flips an exponent bit in one gradient tensor of a
+seeded fit() (the seed picks which) and requires the training guardian
+to detect it, roll back to the last-good ring snapshot, and replay to a
+final state bit-identical to an uninjected control run; it also pushes a
+NaN-poisoned gradient at a kvstore server and requires a typed NACK with
+the stored value untouched.
+
 ``membership-churn`` runs N elastic workers against a sync-mode server
 with eviction enabled, hard-kills one mid-run under a seeded FaultPlan
 (the seed picks both the victim rank and the kill step), waits for the
@@ -810,11 +817,182 @@ def run_sparse_replay(seed, timeout=120.0):
     return ok
 
 
+def run_sdc_rollback(seed, timeout=120.0):
+    """Silent-data-corruption containment, both halves of the guardian:
+
+    Training half: the same seeded 2-epoch fit() runs twice — a control
+    run, and a run with ``guardian.grad:bitflip@#N`` installed (the seed
+    picks N, i.e. which gradient tensor of which step takes an exponent
+    bit-flip).  The guardian must catch the poisoned step (the f32
+    grad-norm square-sum overflows to inf), roll back to the last-good
+    ring snapshot — params, updater state, framework PRNG, and the
+    data-iterator cursor — and replay.  Passes when exactly one rollback
+    fired and the final params are bit-identical to the control run.
+
+    Fleet half: a kvstore server takes a clean dense push, then a
+    NaN-poisoned push from another rank.  The poisoned push must be
+    NACKed (typed NonFiniteGradientError at the client, counted per rank
+    in mxtpu_kvsrv_rejected_pushes_total) and the stored value must stay
+    bit-identical to the clean-only state — containment, not detection
+    after the fact."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    env = {"MXNET_FUSED_STEP": "0",     # corruption rewrites host grad
+                                        # buffers, which forces the eager
+                                        # path — the control run must
+                                        # match it for bit-identity
+           "MXNET_GUARDIAN": "1",
+           "MXNET_GUARDIAN_SKIP_MAX": "0",      # straight to rollback
+           "MXNET_GUARDIAN_REWARM_STEPS": "0",
+           "MXNET_GUARDIAN_RING": "2",
+           "MXNET_GUARDIAN_SNAPSHOT_EVERY": "4"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        import numpy as np
+
+        import mxnet_tpu as mx
+        from mxnet_tpu import faults, guardian, telemetry
+        from mxnet_tpu.kvstore_server import (NonFiniteGradientError,
+                                              ServerClient, start_server)
+
+        # the env var only matters at import; in-process (pytest) the
+        # module is long imported, so flip the gate directly
+        guardian.enable()
+
+        def one_fit(spec):
+            guardian.reset_stats()
+            if spec:
+                faults.install(faults.FaultPlan(spec, seed=seed))
+            else:
+                faults.uninstall()
+            try:
+                data = mx.sym.Variable("data")
+                net = mx.sym.FullyConnected(data, name="fc1",
+                                            num_hidden=16)
+                net = mx.sym.Activation(net, name="relu1",
+                                        act_type="relu")
+                net = mx.sym.FullyConnected(net, name="fc2", num_hidden=4)
+                net = mx.sym.SoftmaxOutput(net, name="softmax")
+                mod = mx.mod.Module(net, context=mx.cpu())
+                mx.random.seed(3)
+                np.random.seed(3)
+                rng = np.random.RandomState(7)
+                x = rng.randn(64, 10).astype(np.float32)
+                y = rng.randint(0, 4, (64,)).astype(np.float32)
+                it = mx.io.NDArrayIter(x, y, batch_size=8, shuffle=True,
+                                       label_name="softmax_label")
+                mod.fit(it, num_epoch=2, optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.05,
+                                          "momentum": 0.9},
+                        initializer=mx.init.Xavier(), eval_metric="acc")
+                args, _ = mod.get_params()
+                return ({k: v.asnumpy() for k, v in args.items()},
+                        guardian.stats())
+            finally:
+                faults.uninstall()
+
+        # 16 steps x 4 gradient tensors -> 64 corruption polls; the seed
+        # picks which one flips (any step of either epoch), and #1 — the
+        # very first gradient, before the spike detector has any history
+        # — is always exercised too (the acceptance-pinned worst case)
+        n = 1 + np.random.RandomState(seed).randint(64)
+        clean, st_clean = one_fit(None)
+
+        ok = True
+        if st_clean["rollbacks"] != 0 or st_clean["anomalies"] != 0:
+            print("chaos_run: sdc-rollback control run tripped the "
+                  "guardian: %r" % (st_clean,), file=sys.stderr, flush=True)
+            ok = False
+        for idx in sorted({1, n}):
+            inj, st_inj = one_fit("guardian.grad:bitflip@#%d" % idx)
+            if st_inj["anomalies"] < 1 or st_inj["rollbacks"] != 1:
+                print("chaos_run: sdc-rollback injected run (bitflip@#%d) "
+                      "expected 1 rollback, got %r" % (idx, st_inj),
+                      file=sys.stderr, flush=True)
+                ok = False
+            diverged = [k for k in clean
+                        if clean[k].tobytes() != inj[k].tobytes()]
+            if diverged:
+                print("chaos_run: sdc-rollback bitflip@#%d replay diverged "
+                      "from control in %s" % (idx, ", ".join(sorted(diverged))),
+                      file=sys.stderr, flush=True)
+                ok = False
+        if ok:
+            print("chaos_run: sdc-rollback ok: bitflip@#{1,%d} detected, "
+                  "1 rollback each, replays bit-identical to control" % n,
+                  file=sys.stderr, flush=True)
+
+        # ---- fleet half: server-side NACK containment
+        telemetry.enable(trace=False)
+        srv = start_server(port=0)
+        cli = ServerClient(*srv.addr)
+        try:
+            cli.init(0, np.zeros(8, dtype=np.float32))
+            good = np.random.RandomState(seed + 1).randn(8) \
+                .astype(np.float32)
+            cli.push(0, good, rank=0)
+            want = cli.pull(0).tobytes()
+            # the registry is process-global: under --seeds sweeps the
+            # counter carries over from earlier iterations, so assert
+            # the delta, not the absolute count
+            rej0 = telemetry.registry().snapshot().get(
+                "mxtpu_kvsrv_rejected_pushes_total", {}).get("3", 0)
+            bad = good.copy()
+            bad[int(seed) % 8] = np.nan
+            try:
+                cli.push(0, bad, rank=3)
+                print("chaos_run: sdc-rollback poisoned push was ACKed",
+                      file=sys.stderr, flush=True)
+                ok = False
+            except NonFiniteGradientError:
+                pass
+            if cli.pull(0).tobytes() != want:
+                print("chaos_run: sdc-rollback NACKed push mutated the "
+                      "store", file=sys.stderr, flush=True)
+                ok = False
+            rej = telemetry.registry().snapshot().get(
+                "mxtpu_kvsrv_rejected_pushes_total", {})
+            if srv.rejected_pushes != 1 or rej.get("3", 0) - rej0 != 1:
+                print("chaos_run: sdc-rollback rejected-push accounting "
+                      "off: server=%d telemetry=%r"
+                      % (srv.rejected_pushes, rej),
+                      file=sys.stderr, flush=True)
+                ok = False
+            elif ok:
+                print("chaos_run: sdc-rollback ok: poisoned push NACKed, "
+                      "store bit-identical, rank 3 counted",
+                      file=sys.stderr, flush=True)
+        finally:
+            try:
+                cli.stop_server()
+            except Exception:
+                pass
+            cli.close()
+            telemetry.disable()
+        return ok
+    finally:
+        try:
+            from mxnet_tpu import guardian as _g
+            _g.disable()
+        except Exception:
+            pass
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 _SCENARIOS = {"membership-churn": run_membership_churn,
               "serving-failover": run_serving_failover,
               "flash-crowd": run_flash_crowd,
               "decode-storm": run_decode_storm,
-              "sparse-replay": run_sparse_replay}
+              "sparse-replay": run_sparse_replay,
+              "sdc-rollback": run_sdc_rollback}
 
 
 def main():
