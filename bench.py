@@ -99,6 +99,11 @@ def _arg_parser():
     ap.add_argument("--coldstart-timeout", type=int, default=300,
                     help="seconds before each cold-start subprocess is "
                          "killed")
+    ap.add_argument("--skip-platform", action="store_true",
+                    help="skip the CPU-only multi-model platform phase "
+                         "(tools/bench_platform.py)")
+    ap.add_argument("--platform-timeout", type=int, default=300,
+                    help="seconds before the platform phase is killed")
     ap.add_argument("--skip-generate", action="store_true",
                     help="omit the CPU-only continuous-batching "
                          "generation phase")
@@ -574,6 +579,44 @@ def _generate_fields(timeout=600):
                                             "; ".join(tail[-2:])[:300])}
 
 
+def _platform_fields(timeout=300):
+    """CPU-only multi-model platform phase (tools/bench_platform.py) in
+    a subprocess: N models on a pool with room for N/2, diurnal demand
+    swings driving page-out/fault-in cycles over AOT bundles, plus a
+    tenant flood measuring per-tenant shed isolation."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_platform.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run([sys.executable, script],
+                              capture_output=True, text=True,
+                              timeout=timeout, env=env)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        return {"platform_error": str(e)[:300]}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        return {
+            "platform_models": rec.get("models"),
+            "platform_capacity_models": rec.get("capacity_models"),
+            "platform_cold_fault_in_ms": rec.get("cold_fault_in_ms"),
+            "platform_warm_fault_in_ms": rec.get("warm_fault_in_ms"),
+            "platform_warm_speedup": rec.get("warm_speedup"),
+            "platform_fault_ins": rec.get("fault_ins"),
+            "platform_page_outs": rec.get("page_outs"),
+            "platform_warm_cold_bucket_runs":
+                rec.get("warm_cold_bucket_runs"),
+            "platform_tenant_p99_ms": rec.get("tenant_p99_ms"),
+            "platform_noisy_shed": rec.get("noisy_shed"),
+            "platform_good_shed": rec.get("good_shed"),
+        }
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return {"platform_error": "rc=%d %s" % (proc.returncode,
+                                            "; ".join(tail[-2:])[:300])}
+
+
 def _probe_backend(timeout=300):
     """Claim and release the backend in a subprocess. Returns None when
     healthy, else a short error string."""
@@ -622,6 +665,8 @@ def orchestrate(argv=None):
         _coldstart_fields(cli.coldstart_timeout)
     generate_fields = {} if cli.skip_generate else \
         _generate_fields(cli.generate_timeout)
+    platform_fields = {} if cli.skip_platform else \
+        _platform_fields(cli.platform_timeout)
 
     def finish(rec):
         rec.update(kv_fields)
@@ -629,6 +674,7 @@ def orchestrate(argv=None):
         rec.update(shard_fields)
         rec.update(coldstart_fields)
         rec.update(generate_fields)
+        rec.update(platform_fields)
         print(json.dumps(rec))
         return rec
 

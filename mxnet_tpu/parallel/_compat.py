@@ -1,0 +1,31 @@
+"""jax-version shims for the parallel subpackage.
+
+Newer jax promotes ``shard_map`` to the top level, renames its
+replication checker ``check_rep`` -> ``check_vma``, and types
+manual-mode values with varying-axis annotations (``lax.pcast``).
+jax < 0.5 has none of these; map onto what exists so the same SPMD
+code traces on both.
+"""
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        # old shard_map's rep checker predates varying-axis types and
+        # rejects mixed-rep scan carries the new checker accepts; the
+        # pcast annotations that would fix them don't exist here
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+    kw = {} if check_vma is None else {"check_vma": check_vma}
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def pcast_varying(x, axes):
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to="varying")
+    return x  # no varying-axis types on old jax; nothing to annotate

@@ -69,8 +69,9 @@ def moe_ffn(x, gate_w, w1, w2, mesh, axis: str = "expert", top_k: int = 1,
     dimension over ``axis`` of ``mesh``; ``x``/``gate_w`` replicated.
     Exact — matches ``moe_ffn_reference`` to float tolerance."""
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ._compat import shard_map
 
     act = act or jax.nn.gelu
     n = mesh.shape[axis]

@@ -24,10 +24,11 @@ def _pipeline_inner(params, xs, *, axis, n_stages, n_micro, stage_fn):
     micro_shape = xs.shape[1:]
     # initial carries must be typed varying over the pipe axis (shard_map
     # VMA typing — the loop outputs depend on stage-varying params)
-    state0 = lax.pcast(jnp.zeros(micro_shape, xs.dtype), (axis,),
-                       to="varying")
-    out0 = lax.pcast(jnp.zeros((n_micro,) + micro_shape, xs.dtype), (axis,),
-                     to="varying")
+    from ._compat import pcast_varying
+
+    state0 = pcast_varying(jnp.zeros(micro_shape, xs.dtype), (axis,))
+    out0 = pcast_varying(jnp.zeros((n_micro,) + micro_shape, xs.dtype),
+                         (axis,))
     fwd_perm = [(j, j + 1) for j in range(n_stages - 1)]
 
     def step(carry, t):
@@ -70,8 +71,9 @@ def pipeline_spmd(stage_fn, stage_params, x, mesh, axis: str = "pipe",
     over ``axis``)."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ._compat import shard_map
 
     n_stages = mesh.shape[axis]
     if n_microbatches is None:

@@ -60,8 +60,10 @@ def _ring_inner(q, k, v, *, axis, vary_axes, n_shards, causal, scale):
 
     # initial accumulators must carry the same varying-axis type as the
     # loop outputs (shard_map VMA typing)
+    from ._compat import pcast_varying
+
     def _vary(x):
-        return lax.pcast(x, vary_axes, to="varying")
+        return pcast_varying(x, vary_axes)
 
     o0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32))
     m0 = _vary(jnp.full((b, h, sq), _NEG, jnp.float32))
@@ -103,8 +105,9 @@ def ring_attention(q, k, v, mesh, axis: str = "seq",
     q, k, v: [batch, seq, heads, head_dim] global arrays (sequence may be
     sharded on ``axis``; batch optionally on ``batch_axis``)."""
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ._compat import shard_map
 
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
@@ -144,8 +147,10 @@ def _ring_flash_fwd(q, k, v, *, axis, vary_axes, n_shards, causal, scale,
     b, sq, h, d = q.shape
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
 
+    from ._compat import pcast_varying
+
     def _vary(x):
-        return lax.pcast(x, vary_axes, to="varying")
+        return pcast_varying(x, vary_axes)
 
     o0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32))
     lse0 = _vary(jnp.full((b * h, sq), _NEG, jnp.float32))
@@ -196,8 +201,10 @@ def _ring_flash_bwd(q, k, v, o, lse, do, *, axis, vary_axes, n_shards,
     b, sq, h, d = q.shape
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
 
+    from ._compat import pcast_varying
+
     def _vary(x):
-        return lax.pcast(x, vary_axes, to="varying")
+        return pcast_varying(x, vary_axes)
 
     dq0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32))
     dkv0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32))
@@ -261,8 +268,9 @@ def ring_flash_attention(q, k, v, mesh, axis: str = "seq",
     partials merged by logsumexp. Exact; O(seq/n) memory per device with
     VMEM-streamed blocks — the long-context training path end to end."""
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ._compat import shard_map
 
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
@@ -315,8 +323,9 @@ def ulysses_attention(q, k, v, mesh, axis: str = "seq",
     """All-to-all sequence parallelism: heads are sharded during attention,
     sequence is sharded elsewhere. Requires heads % mesh.shape[axis] == 0."""
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ._compat import shard_map
 
     n_shards = mesh.shape[axis]
     if q.shape[2] % n_shards:

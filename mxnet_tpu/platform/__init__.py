@@ -1,0 +1,45 @@
+"""Multi-tenant model platform over the serving fleet.
+
+The serving stack below this package (router, autoscaler, registry)
+assumes ONE model per fleet.  The platform turns that fleet into a
+shared pool serving many models for many tenants:
+
+* :class:`ModelSpec` — one registered model: checkpoint prefix, input
+  shapes, tenant, SLO class, and an estimated device **footprint**
+  (param bytes via ``sharding.param_bytes`` / checkpoint size, KV-pool
+  bytes for generator specs, executable overhead refined from
+  ``hlo_analysis`` cost analysis once the model has run).
+* :class:`PlacementPlanner` — bin-packs registered models onto a
+  :class:`DevicePool` by footprint, demand, and SLO class; emits a
+  :class:`PlacementPlan` plus the page-out / fault-in / migrate actions
+  that reconcile the current placement to it.
+* :class:`ModelManager` — actuates plans: hot models live as
+  :class:`~mxnet_tpu.serving.server.InferenceServer` replicas
+  (registered with ``model``/``tenant`` meta so per-model routers can
+  filter one shared registry); cold models are paged out to AOT bundles
+  and faulted back in warm via ``from_checkpoint(attach_aot=True)``
+  with zero cold-bucket runs.
+* :class:`TenantQuotas` — per-tenant admission control: token-bucket
+  rate limits plus weighted fair sharing under pressure, so one
+  tenant's flood sheds THAT tenant (429 + Retry-After), never its
+  neighbours.
+* :class:`FrontDoor` — the multi-model request path: model name in the
+  URL path or ``X-MXNet-Model`` header, tenant in ``X-Tenant``, routed
+  through per-model router views over one replica registry.
+
+Every planner decision is a ``mxnet_tpu.faults`` dotted op
+(``platform.plan`` / ``platform.page_out`` / ``platform.fault_in`` /
+``platform.migrate``), so the chaos harness drives placement churn
+deterministically.
+"""
+from .spec import ModelSpec
+from .planner import DevicePool, PlacementPlan, PlacementPlanner
+from .quotas import TenantQuotaExceededError, TenantQuotas
+from .manager import ModelManager, PlatformMetrics
+from .frontdoor import FrontDoor
+
+__all__ = [
+    "ModelSpec", "DevicePool", "PlacementPlan", "PlacementPlanner",
+    "TenantQuotas", "TenantQuotaExceededError", "ModelManager",
+    "PlatformMetrics", "FrontDoor",
+]

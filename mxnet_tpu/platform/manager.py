@@ -1,0 +1,371 @@
+"""ModelManager — actuates placement plans over real InferenceServers.
+
+The planner decides *what should be resident*; the manager makes it so:
+
+* **fault_in** — build a warm :class:`InferenceServer` via
+  ``from_checkpoint(attach_aot=True)`` (the AOT bundle beside the
+  checkpoint makes every bucket warm by deserialization — zero
+  cold-bucket runs), register it in the shared replica registry with
+  ``{"model", "tenant"}`` meta so model-scoped routers adopt it, and
+  start its heartbeat.
+* **page_out** — save the server's AOT bundle (executables + tuning
+  entries travel with the checkpoint; the NEXT fault-in warms from it),
+  deregister, then ``stop()`` — which releases the device-resident
+  params and executables (satellite fix: a paged-out model must not pin
+  device memory; ``mxtpu_platform_resident_bytes`` proves it fell).
+* **migrate** — fault the model in at its new device, then page the old
+  copy out: capacity never dips mid-migration.
+* **replan** — one planner pass + actuation, page-outs first (freeing
+  the bytes the fault-ins then claim), with a minimum-residency
+  anti-thrash guard so diurnal demand wiggle cannot flap a model in and
+  out every tick.
+
+Every actuation is a ``faults`` dotted op (``platform.fault_in`` /
+``platform.page_out`` / ``platform.migrate``) and counts in the
+model-labeled platform telemetry.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, Optional
+
+from .. import faults
+from .. import telemetry as _telemetry
+from ..base import MXNetError, env, register_env
+from ..serving.registry import ReplicaRegistry, start_heartbeater
+from ..serving.server import InferenceServer
+from .planner import DevicePool, PlacementPlanner
+from .spec import ModelSpec
+
+__all__ = ["ModelManager", "PlatformMetrics"]
+
+register_env("MXNET_PLATFORM_REPLAN_MS", 2000.0, float,
+             "Background placement-replan period of a started "
+             "ModelManager (0 disables the loop; replan() stays "
+             "callable).")
+register_env("MXNET_PLATFORM_DEMAND_HALFLIFE_S", 30.0, float,
+             "Half-life of the per-model demand EWMA the placement "
+             "planner scores against — shorter chases diurnal load "
+             "faster, longer resists thrash.")
+register_env("MXNET_PLATFORM_MIN_RESIDENT_S", 5.0, float,
+             "Anti-thrash guard: a model faulted in more recently than "
+             "this is not paged out by a replan (explicit page_out() "
+             "calls are not gated).")
+
+
+class PlatformMetrics:
+    """Model-labeled platform telemetry (a registry collector)."""
+
+    def __init__(self):
+        reg = self._registry = _telemetry.Registry()
+        self.fault_ins = reg.labeled_counter(
+            "mxtpu_platform_fault_ins_total", "model")
+        self.page_outs = reg.labeled_counter(
+            "mxtpu_platform_page_outs_total", "model")
+        self.migrations = reg.labeled_counter(
+            "mxtpu_platform_migrations_total", "model")
+        self.plans = reg.counter("mxtpu_platform_plans_total")
+        self.g_resident = reg.gauge("mxtpu_platform_resident_models")
+        self.g_registered = reg.gauge("mxtpu_platform_registered_models")
+        self.g_resident_bytes = reg.gauge("mxtpu_platform_resident_bytes")
+        _telemetry.register_collector(self)
+
+    def render_prometheus(self):
+        return self._registry.render_prometheus()
+
+
+class ModelManager:
+    """Owns the model catalog, the demand signal, and the live servers.
+
+    Parameters
+    ----------
+    pool : DevicePool
+        The memory budget placements pack against.
+    registry : ReplicaRegistry, optional
+        Shared replica live-set; created (in-process) when absent.
+        Every faulted-in server registers here with model/tenant meta.
+    planner : PlacementPlanner, optional
+        Defaults to a fresh planner over ``pool``.
+    """
+
+    def __init__(self, pool: DevicePool, registry=None,
+                 planner: Optional[PlacementPlanner] = None):
+        self.pool = pool
+        self.registry = ReplicaRegistry() if registry is None else registry
+        self.planner = PlacementPlanner(pool) if planner is None else planner
+        self.metrics = PlatformMetrics()
+        self._lock = threading.RLock()
+        self._specs: Dict[str, ModelSpec] = {}
+        self._servers: Dict[str, InferenceServer] = {}
+        self._beat_stops: Dict[str, object] = {}
+        self._placement: Dict[str, int] = {}
+        self._resident_since: Dict[str, float] = {}
+        self._demand: Dict[str, float] = {}
+        self._demand_t: Dict[str, float] = {}
+        self._fault_in_ms: Dict[str, float] = {}
+        self._replica_seq = 0
+        self._halflife_s = env("MXNET_PLATFORM_DEMAND_HALFLIFE_S", 30.0,
+                               float)
+        self._min_resident_s = env("MXNET_PLATFORM_MIN_RESIDENT_S", 5.0,
+                                   float)
+        self._loop_stop = threading.Event()
+        self._loop_thread = None
+        self._closed = False
+
+    # -- catalog -----------------------------------------------------------
+    def register_model(self, spec: ModelSpec):
+        with self._lock:
+            if spec.name in self._specs:
+                raise MXNetError("model %r already registered" % spec.name)
+            self._specs[spec.name] = spec
+            self._demand.setdefault(spec.name, 0.0)
+        self.metrics.g_registered.set(len(self._specs))
+        _telemetry.log_event("platform_register", model=spec.name,
+                             tenant=spec.tenant, slo=spec.slo)
+        return spec
+
+    def spec(self, name: str) -> ModelSpec:
+        with self._lock:
+            spec = self._specs.get(name)
+        if spec is None:
+            raise MXNetError("unknown model %r (registered: %s)"
+                             % (name, sorted(self._specs)))
+        return spec
+
+    def models(self):
+        with self._lock:
+            return sorted(self._specs)
+
+    # -- demand signal -----------------------------------------------------
+    def record_demand(self, name: str, n: float = 1.0):
+        """Fold ``n`` requests into the model's demand EWMA (decayed by
+        the configured half-life since the last observation)."""
+        now = time.monotonic()
+        with self._lock:
+            cur = self._decayed_demand_locked(name, now)
+            self._demand[name] = cur + float(n)
+            self._demand_t[name] = now
+
+    def _decayed_demand_locked(self, name, now):
+        last = self._demand_t.get(name)
+        cur = self._demand.get(name, 0.0)
+        if last is None or self._halflife_s <= 0:
+            return cur
+        return cur * math.pow(0.5, (now - last) / self._halflife_s)
+
+    def demand(self) -> Dict[str, float]:
+        now = time.monotonic()
+        with self._lock:
+            return {n: self._decayed_demand_locked(n, now)
+                    for n in self._specs}
+
+    # -- actuation ---------------------------------------------------------
+    def _next_replica_name(self, model):
+        self._replica_seq += 1
+        return "%s/r%d" % (model, self._replica_seq)
+
+    def fault_in(self, name: str, device: Optional[int] = None):
+        """Materialize one model as a live warm replica; returns the
+        server.  Idempotent for already-resident models."""
+        spec = self.spec(name)
+        with self._lock:
+            if name in self._servers:
+                return self._servers[name]
+        faults.fire("platform.fault_in")
+        t0 = time.monotonic()
+        kwargs = dict(spec.server_kwargs)
+        if spec.generator_spec is not None:
+            kwargs.setdefault("generator_spec", dict(spec.generator_spec))
+        server = InferenceServer.from_checkpoint(
+            spec.prefix, spec.epoch, spec.input_shapes, attach_aot=True,
+            **kwargs)
+        self._observe_exec_bytes(spec, server)
+        rep_name = None
+        with self._lock:
+            if name in self._servers:  # raced another fault_in
+                srv = self._servers[name]
+            else:
+                rep_name = self._next_replica_name(name)
+                self._servers[name] = server
+                self._placement[name] = 0 if device is None else int(device)
+                self._resident_since[name] = time.monotonic()
+                srv = server
+        if rep_name is None:
+            server.stop(drain=False)
+            return srv
+        self._beat_stops[name] = start_heartbeater(
+            self.registry, rep_name, server,
+            meta={"model": name, "tenant": spec.tenant})
+        dt_ms = (time.monotonic() - t0) * 1e3
+        self._fault_in_ms[name] = dt_ms
+        self.metrics.fault_ins.inc(name)
+        self._update_gauges()
+        _telemetry.log_event("platform_fault_in", model=name,
+                             device=self._placement[name],
+                             ms=round(dt_ms, 1),
+                             cold_runs=server.cold_bucket_runs())
+        return server
+
+    def page_out(self, name: str):
+        """Demote one model to its on-disk AOT bundle and release its
+        device memory.  No-op for non-resident models."""
+        with self._lock:
+            server = self._servers.pop(name, None)
+            stop_beat = self._beat_stops.pop(name, None)
+            self._placement.pop(name, None)
+            self._resident_since.pop(name, None)
+        if server is None:
+            return
+        faults.fire("platform.page_out")
+        spec = self.spec(name)
+        # bundle BEFORE stop: compiled_entries() is empty once the
+        # predictors are released
+        try:
+            if server.compiled_entries():
+                server.save_aot_bundle(spec.prefix, spec.epoch)
+        except Exception:
+            pass  # bundle refresh is best-effort; next fault-in still
+            # warms from the previous bundle (or compiles)
+        if stop_beat is not None:
+            stop_beat()
+        server.stop(drain=True)
+        self.metrics.page_outs.inc(name)
+        self._update_gauges()
+        _telemetry.log_event("platform_page_out", model=name,
+                             resident_bytes=server.resident_bytes())
+
+    def migrate(self, name: str, device: int):
+        """Move a resident model to another device (fault-in first, so
+        capacity never dips)."""
+        faults.fire("platform.migrate")
+        with self._lock:
+            if name not in self._servers:
+                return self.fault_in(name, device)
+        self.page_out(name)
+        server = self.fault_in(name, device)
+        self.metrics.migrations.inc(name)
+        return server
+
+    def replan(self):
+        """One planner pass + actuation; returns the plan."""
+        with self._lock:
+            specs = dict(self._specs)
+            current = dict(self._placement)
+            since = dict(self._resident_since)
+        plan = self.planner.plan(specs, self.demand(), current)
+        self.metrics.plans.inc()
+        now = time.monotonic()
+        for act in plan.actions:
+            model = act["model"]
+            if act["op"] == "page_out":
+                if now - since.get(model, 0.0) < self._min_resident_s:
+                    continue  # anti-thrash: too fresh to evict
+                self.page_out(model)
+            elif act["op"] == "fault_in":
+                self.fault_in(model, act["device"])
+            elif act["op"] == "migrate":
+                self.migrate(model, act["dst"])
+        return plan
+
+    # -- observability -----------------------------------------------------
+    def _observe_exec_bytes(self, spec, server):
+        """Refine the spec's executable-footprint estimate from the live
+        server's XLA cost analysis (when the compile cache primed it).
+        ``bytes_accessed`` counts the param reads too; those bytes are
+        already in ``param_footprint``, so only the excess over the
+        server's resident param bytes counts as executable overhead."""
+        try:
+            total = 0
+            for entry in server.compiled_entries():
+                info = getattr(entry, "cost_info", None)
+                if info and info.get("bytes_accessed"):
+                    total += int(info["bytes_accessed"])
+            if total:
+                spec.observe_exec_bytes(
+                    max(0, total - server.resident_bytes()))
+        except Exception:
+            pass
+
+    def resident_bytes(self) -> int:
+        """Device bytes pinned by resident models right now — the value
+        behind ``mxtpu_platform_resident_bytes``.  Falls after
+        ``page_out`` (the released server reports 0)."""
+        with self._lock:
+            servers = list(self._servers.values())
+        return sum(s.resident_bytes() for s in servers)
+
+    def _update_gauges(self):
+        with self._lock:
+            n = len(self._servers)
+        self.metrics.g_resident.set(n)
+        self.metrics.g_resident_bytes.set(self.resident_bytes())
+
+    def server_for(self, name: str) -> Optional[InferenceServer]:
+        with self._lock:
+            return self._servers.get(name)
+
+    def placement(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._placement)
+
+    def fault_in_latency_ms(self, name: str) -> Optional[float]:
+        return self._fault_in_ms.get(name)
+
+    def describe(self) -> dict:
+        with self._lock:
+            resident = sorted(self._servers)
+            placement = dict(self._placement)
+        return {
+            "models": {n: self.spec(n).describe() for n in self.models()},
+            "resident": resident,
+            "placement": placement,
+            "paged": sorted(set(self.models()) - set(resident)),
+            "demand": {n: round(v, 2) for n, v in self.demand().items()},
+            "resident_bytes": self.resident_bytes(),
+            "pool": self.pool.describe(),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, replan_ms: Optional[float] = None):
+        """Start the background replan loop (no-op when the period
+        resolves to 0)."""
+        period_ms = env("MXNET_PLATFORM_REPLAN_MS", 2000.0, float) \
+            if replan_ms is None else float(replan_ms)
+        if period_ms <= 0 or self._loop_thread is not None:
+            return self
+        period_s = period_ms / 1e3
+
+        def loop():
+            while not self._loop_stop.wait(period_s):
+                try:
+                    self.replan()
+                except Exception:
+                    pass  # one bad tick must not kill the planner
+
+        self._loop_thread = threading.Thread(
+            target=loop, name="mxtpu-platform-replan", daemon=True)
+        self._loop_thread.start()
+        return self
+
+    def close(self):
+        """Stop the loop and page out every resident model."""
+        if self._closed:
+            return
+        self._closed = True
+        self._loop_stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5)
+            self._loop_thread = None
+        for name in list(self._servers):
+            try:
+                self.page_out(name)
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,150 @@
+"""PlacementPlanner — bin-pack models onto the device pool by demand.
+
+The planner answers one question every replan tick: *which models
+deserve to be resident right now, and where?*  Inputs are the
+registered :class:`~mxnet_tpu.platform.spec.ModelSpec` footprints, a
+demand estimate per model (the manager's request-rate EWMA), and the
+current placement.  Output is a :class:`PlacementPlan` plus the action
+list (page-out / fault-in / migrate) that reconciles reality to it.
+
+The packing itself is first-fit-decreasing — the classic bin-packing
+heuristic: score models by ``demand x weight`` (SLO rank breaks ties:
+interactive beats generate beats batch), walk them best-first, place
+each on the device with the most free bytes that still fits it.
+Models that fit nowhere are planned *paged* — they live as AOT bundles
+on disk until demand earns them a slot.  Sticky placement: a model
+already resident on a device that still fits stays there (a replan must
+not churn placements for equal-score shuffles — migrations cost warm
+fault-ins).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from .. import faults
+from .. import telemetry as _telemetry
+from ..base import MXNetError, env, register_env
+
+__all__ = ["DevicePool", "PlacementPlan", "PlacementPlanner"]
+
+register_env("MXNET_PLATFORM_DEVICE_BYTES", 16 << 30, int,
+             "Per-device memory budget (bytes) the placement planner "
+             "packs model footprints against when the pool does not "
+             "declare one explicitly.")
+
+
+class DevicePool:
+    """The memory budget the planner packs against: N devices of B
+    bytes.  Defaults to the visible JAX device count and the
+    ``MXNET_PLATFORM_DEVICE_BYTES`` budget — tests pass tiny explicit
+    pools to simulate '10 models, room for 4'."""
+
+    def __init__(self, num_devices: Optional[int] = None,
+                 bytes_per_device: Optional[int] = None):
+        if num_devices is None:
+            import jax
+
+            num_devices = len(jax.devices())
+        self.num_devices = int(num_devices)
+        if self.num_devices < 1:
+            raise MXNetError("device pool needs >= 1 device")
+        self.bytes_per_device = (
+            env("MXNET_PLATFORM_DEVICE_BYTES", 16 << 30, int)
+            if bytes_per_device is None else int(bytes_per_device))
+
+    def total_bytes(self) -> int:
+        return self.num_devices * self.bytes_per_device
+
+    def describe(self) -> dict:
+        return {"num_devices": self.num_devices,
+                "bytes_per_device": self.bytes_per_device}
+
+
+class PlacementPlan:
+    """One planner output: ``resident`` maps model name -> device id,
+    ``paged`` lists the models living as bundles, ``actions`` is the
+    reconciliation the manager actuates (in order: page-outs free the
+    memory the fault-ins then claim)."""
+
+    __slots__ = ("resident", "paged", "actions", "free_bytes")
+
+    def __init__(self, resident: Dict[str, int], paged: List[str],
+                 actions: List[dict], free_bytes: Dict[int, int]):
+        self.resident = resident
+        self.paged = paged
+        self.actions = actions
+        self.free_bytes = free_bytes
+
+    def describe(self) -> dict:
+        return {"resident": dict(self.resident), "paged": list(self.paged),
+                "actions": [dict(a) for a in self.actions],
+                "free_bytes": dict(self.free_bytes)}
+
+
+class PlacementPlanner:
+    """First-fit-decreasing packer with sticky placement."""
+
+    def __init__(self, pool: DevicePool):
+        self.pool = pool
+        self._lock = threading.Lock()
+
+    def plan(self, specs: Dict[str, object], demand: Dict[str, float],
+             current: Optional[Dict[str, int]] = None) -> PlacementPlan:
+        """Pack ``specs`` (name -> ModelSpec) onto the pool.
+
+        ``demand`` is requests/s per model (missing == 0); ``current``
+        is the live placement (name -> device) used both for stickiness
+        and to derive the page-out/fault-in/migrate action diff.
+        """
+        faults.fire("platform.plan")
+        current = dict(current or {})
+        with self._lock:
+            order = sorted(
+                specs.values(),
+                key=lambda s: (-(demand.get(s.name, 0.0) * s.weight),
+                               s.slo_rank(), s.name))
+            free = {d: self.pool.bytes_per_device
+                    for d in range(self.pool.num_devices)}
+            resident: Dict[str, int] = {}
+            paged: List[str] = []
+            for spec in order:
+                need = spec.footprint()["total"]
+                if need > self.pool.bytes_per_device:
+                    raise MXNetError(
+                        "model %r (%d bytes) cannot fit any device "
+                        "(%d bytes)" % (spec.name, need,
+                                        self.pool.bytes_per_device))
+                # sticky: keep the current device while it still fits
+                dev = current.get(spec.name)
+                if dev is not None and dev in free and free[dev] >= need:
+                    free[dev] -= need
+                    resident[spec.name] = dev
+                    continue
+                # first fit on the most-free device (best-fit-decreasing
+                # by free space keeps large contiguous headroom)
+                cand = max(free, key=lambda d: (free[d], -d))
+                if free[cand] >= need:
+                    free[cand] -= need
+                    resident[spec.name] = cand
+                else:
+                    paged.append(spec.name)
+
+        actions = []
+        for name in sorted(current):
+            if name not in resident:
+                actions.append({"op": "page_out", "model": name,
+                                "device": current[name]})
+        for name, dev in sorted(resident.items()):
+            old = current.get(name)
+            if old is None:
+                actions.append({"op": "fault_in", "model": name,
+                                "device": dev})
+            elif old != dev:
+                actions.append({"op": "migrate", "model": name,
+                                "src": old, "dst": dev})
+        plan = PlacementPlan(resident, paged, actions, free)
+        _telemetry.log_event(
+            "platform_plan", resident=len(resident), paged=len(paged),
+            actions=len(actions))
+        return plan

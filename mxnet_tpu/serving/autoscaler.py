@@ -351,13 +351,16 @@ class LocalCheckpointProvider:
 
     def __init__(self, prefix, epoch, input_shapes, registry=None,
                  attach_aot: bool = True, name_prefix: str = "auto",
-                 **server_kwargs):
+                 meta=None, **server_kwargs):
         self._prefix = prefix
         self._epoch = int(epoch)
         self._input_shapes = dict(input_shapes)
         self._registry = registry
         self._attach_aot = bool(attach_aot)
         self._name_prefix = name_prefix
+        # registration meta (e.g. {"model": ..., "tenant": ...}) so
+        # model-scoped routers adopt only this provider's replicas
+        self._meta = dict(meta) if meta else None
         self._server_kwargs = dict(server_kwargs)
         self._seq = itertools.count()
         self._beat_stops = {}
@@ -373,7 +376,7 @@ class LocalCheckpointProvider:
             attach_aot=self._attach_aot, **self._server_kwargs)
         if self._registry is not None:
             self._beat_stops[name] = start_heartbeater(
-                self._registry, name, server)
+                self._registry, name, server, meta=self._meta)
         return name, server
 
     def retire(self, name, server):

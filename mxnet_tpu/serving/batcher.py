@@ -292,6 +292,18 @@ class MicroBatcher:
             if drain and any(w.is_alive() for w in self._workers):
                 self._force_cancel()
 
+    def release(self):
+        """Drop the predictor references after :meth:`stop` so a paged-out
+        server stops pinning device memory.  The worker threads have
+        exited (or, post drain-timeout, can only be wedged inside a
+        backend call that already holds its own transient reference), so
+        nothing dereferences the replica list again; without this, a
+        stopped in-process server keeps every bucket executable and the
+        parameter arrays alive through this closure — the exact leak the
+        platform's ``page_out`` must not have."""
+        with self._cv:
+            self._replicas = []
+
     def _force_cancel(self):
         """Drain deadline expired: fail every future still outstanding
         (queued or dequeued-but-unfinished) with the typed drain error.

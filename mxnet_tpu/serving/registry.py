@@ -124,13 +124,19 @@ class ReplicaRegistry:
         return stale
 
     def live(self) -> dict:
-        """``{"gen": G, "replicas": {name: backend}}`` after sweeping
-        stale members (the poll every router syncs against)."""
+        """``{"gen": G, "replicas": {name: backend}, "meta": {name:
+        dict}}`` after sweeping stale members (the poll every router
+        syncs against).  ``meta`` is additive — pre-platform consumers
+        that only read ``replicas`` keep working, and a member that
+        registered without meta shows an empty dict (the default-model
+        convention the per-model router filter relies on)."""
         with self._lock:
             stale = self._evict_stale_locked()
             out = {"gen": self._gen,
                    "replicas": {n: rec["backend"]
-                                for n, rec in self._members.items()}}
+                                for n, rec in self._members.items()},
+                   "meta": {n: dict(rec["meta"])
+                            for n, rec in self._members.items()}}
         for n in stale:
             _telemetry.log_event("serving_registry", op="evict", name=n,
                                  gen=out["gen"])

@@ -738,6 +738,11 @@ class Router:
         removed.  N routers attached to one registry converge on the
         same fleet — the front door stops being a single point of
         failure.  With a registry, ``backends`` may be empty.
+    model : str, optional
+        Restrict registry discovery to members whose registration meta
+        carries this ``model`` label (members without one count as
+        ``"default"``).  N model-scoped routers can then share one
+        registry — the multi-model platform's per-model live view.
     """
 
     def __init__(self, backends: Sequence[Union[InferenceServer, str]] = (),
@@ -748,7 +753,8 @@ class Router:
                  hedge_ms: Optional[float] = None,
                  shed_pressure: Optional[float] = None,
                  workers: Optional[int] = None, seed: int = 0,
-                 registry=None, registry_sync_ms: Optional[float] = None):
+                 registry=None, registry_sync_ms: Optional[float] = None,
+                 model: Optional[str] = None):
         if not backends and registry is None:
             raise ValueError("need at least one backend replica "
                              "(or a registry to discover them from)")
@@ -802,6 +808,11 @@ class Router:
         # under registry management are synced against the shared live
         # set; constructor-passed backends stay the caller's.
         self._registry = registry
+        # per-model registry view: with model=<name> only registry
+        # members whose meta carries that model label are adopted
+        # (absent label == "default"), so N model-scoped routers share
+        # ONE registry instead of one registry per model.
+        self._model = model
         self._registry_names: set = set()
         self._registry_gen = -1
         self._registry_stop = threading.Event()
@@ -928,17 +939,31 @@ class Router:
         if live["gen"] == self._registry_gen:
             return
         self._registry_gen = live["gen"]
+        metas = live.get("meta") or {}
+        members = live["replicas"]
+        if self._model is not None:
+            members = {
+                name: backend for name, backend in members.items()
+                if ((metas.get(name) or {}).get("model") or "default")
+                == self._model}
         current = {r.name for r in self.replicas()}
-        for name, backend in live["replicas"].items():
+        for name, backend in members.items():
             if name not in current:
                 try:
                     self.add_replica(backend, name=name)
                 except MXNetError:
                     pass  # raced another sync pass
                 self._registry_names.add(name)
-        for name in sorted(self._registry_names - set(live["replicas"])):
+        for name in sorted(self._registry_names - set(members)):
             self._registry_names.discard(name)
             self.remove_replica(name, wait=False)
+
+    def sync_registry(self):
+        """Force one registry reconciliation pass right now (the
+        background loop runs every MXNET_SERVING_REGISTRY_SYNC_MS).  The
+        platform front door calls this after a fault-in so the first
+        request sees the fresh replica instead of a 500ms-stale view."""
+        self._sync_registry()
 
     def _registry_loop(self):
         while not self._registry_stop.wait(self._registry_sync_s):

@@ -130,6 +130,12 @@ class InferenceServer:
             if max_wait_us is None else max_wait_us,
             max_queue=env("MXNET_SERVING_MAX_QUEUE", 256, int)
             if max_queue is None else max_queue)
+        # snapshots that must survive a post-stop release (swap_config and
+        # the router's capacity estimate read these, possibly on a server
+        # whose predictors were already dropped by page-out)
+        self._max_wait_us = self._batcher.max_wait_us
+        self._max_queue = self._batcher.max_queue
+        self._released_cold_runs = 0
         self._httpd = None
         self._http_thread = None
         # lifecycle for the liveness/readiness split: readiness is gated
@@ -301,6 +307,18 @@ class InferenceServer:
         if self._generator is not None:
             self._generator.stop(drain=drain, timeout=timeout_ms / 1e3)
         self._batcher.stop(drain=drain, timeout=timeout_ms / 1e3)
+        # page-out contract: a stopped server must not pin device memory.
+        # Snapshot the compile-behaviour counter while the predictors are
+        # still alive, then drop every reference to them (bucket
+        # executables, parameter arrays, the generator's KV pool) — the
+        # batcher worker threads have exited, so nothing touches them
+        # again.  Save any AOT bundle BEFORE stopping: compiled_entries()
+        # is empty from here on.
+        self._released_cold_runs = self.cold_bucket_runs()
+        self._batcher.release()
+        self._replicas = []
+        self._generator = None
+        self._model_params = None
 
     def __enter__(self):
         return self.start()
@@ -439,8 +457,8 @@ class InferenceServer:
         cfg = {
             "input_shapes": dict(self._input_shapes),
             "buckets": tuple(self.buckets),
-            "max_wait_us": self._batcher.max_wait_us,
-            "max_queue": self._batcher.max_queue,
+            "max_wait_us": self._max_wait_us,
+            "max_queue": self._max_queue,
             "ctx": list(self._ctxs),
             "dtype": self._dtype,
         }
@@ -451,11 +469,32 @@ class InferenceServer:
     def cold_bucket_runs(self) -> int:
         """Post-warmup flushes that hit a never-warmed bucket, summed
         over replicas — the observable recompile counter for the
-        "steady state never recompiles" acceptance check."""
-        n = sum(rep.cold_runs for rep in self._replicas)
+        "steady state never recompiles" acceptance check.  The count
+        survives :meth:`stop` (which releases the predictors): the
+        platform's paging acceptance reads it on paged-out servers."""
+        n = self._released_cold_runs \
+            + sum(rep.cold_runs for rep in self._replicas)
         if self._generator is not None:
             n += self._generator.cold_decode_runs()
         return n
+
+    def resident_bytes(self) -> int:
+        """Estimated bytes of device-resident model state this server
+        pins: every replica's bound parameter/aux arrays (buckets share
+        one copy per context through ``Predictor.reshape``).  0 once
+        :meth:`stop` has released the predictors — the observable the
+        platform's ``mxtpu_platform_resident_bytes`` gauge sums, proving
+        a page-out actually returned the memory."""
+        from ..sharding.placement import param_bytes
+
+        arrays = []
+        for rep in self._replicas:
+            base = rep._preds[rep.buckets[-1]]
+            arrays.extend(base._exec.arg_dict.values())
+            arrays.extend(base._exec.aux_dict.values())
+        if not arrays:
+            return 0
+        return param_bytes(arrays)[1]
 
     def metrics_text(self):
         return self.metrics.render_text()

@@ -97,6 +97,7 @@ def new_trace_ctx(seed: Optional[str] = None) -> dict:
 
 _SAMPLE_RE = re.compile(
     r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(\S+)\s*$")
+_MODEL_RE = re.compile(r'model="([^"]*)"')
 
 
 def _relabel(text: str, role: str, rank) -> str:
@@ -210,8 +211,10 @@ class FleetAggregator:
         parts = []
         step_ms = []
         skew_ms = []
+        models = set()
         for (role, rank), (text, _t) in sorted(pages.items()):
             parts.append(_relabel(text, role, rank))
+            models.update(_MODEL_RE.findall(text))
             if role == "worker":
                 v = _sample_value(text, "mxtpu_step_last_ms")
                 if v:
@@ -232,6 +235,11 @@ class FleetAggregator:
         if skew_ms:
             fleet.append("# TYPE mxtpu_fleet_sync_skew_ms gauge")
             fleet.append("mxtpu_fleet_sync_skew_ms %.6g" % max(skew_ms))
+        if models:
+            # distinct model= labels across every contributed page —
+            # the platform's per-model cost-attribution sanity signal
+            fleet.append("# TYPE mxtpu_fleet_models gauge")
+            fleet.append("mxtpu_fleet_models %d" % len(models))
         parts.append("\n".join(fleet))
         return "\n".join(p.rstrip("\n") for p in parts if p) + "\n"
 

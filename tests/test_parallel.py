@@ -199,11 +199,11 @@ def test_ring_flash_vma_typing(monkeypatch, causal):
     causal skip branches) and every scan carry must agree."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from mxnet_tpu.ops import attention as att
     from mxnet_tpu.parallel import ring
+    from mxnet_tpu.parallel._compat import shard_map
 
     def dense_fwd(q, k, v, causal, scale, bq, bk, interpret):
         b, s, h, d = q.shape

@@ -371,6 +371,47 @@ def test_aggregator_relabels_and_derives_fleet_gauges():
         agg.stop()
 
 
+def test_aggregator_keeps_models_distinguishable():
+    """Two models pushing from ONE process must stay separate series:
+    the model= label survives the role/rank relabel, per-tenant samples
+    never merge, and the derived mxtpu_fleet_models gauge counts the
+    distinct models (the platform's cost-attribution contract)."""
+    agg = FleetAggregator()
+    agg.start()
+    try:
+        def push(role, rank, body):
+            req = urllib.request.Request(
+                "http://%s/push?role=%s&rank=%d" % (agg.addr, role, rank),
+                data=body.encode(), method="POST")
+            urllib.request.urlopen(req, timeout=5).close()
+
+        push("serving", 0,
+             'mxtpu_platform_fault_ins_total{model="resnet"} 3\n'
+             'mxtpu_platform_fault_ins_total{model="dlrm"} 1\n'
+             'mxtpu_requests_total{model="resnet",tenant="acme"} 10\n'
+             'mxtpu_requests_total{model="resnet",tenant="globex"} 7\n')
+        push("serving", 1,
+             'mxtpu_platform_fault_ins_total{model="lm"} 2\n')
+        page = urllib.request.urlopen(
+            "http://%s/metrics" % agg.addr, timeout=5).read().decode()
+        # model label preserved through relabeling, one series per model
+        assert ('mxtpu_platform_fault_ins_total{model="resnet",'
+                'role="serving",rank="0"} 3') in page
+        assert ('mxtpu_platform_fault_ins_total{model="dlrm",'
+                'role="serving",rank="0"} 1') in page
+        assert ('mxtpu_platform_fault_ins_total{model="lm",'
+                'role="serving",rank="1"} 2') in page
+        # no cross-tenant merging: both tenants keep their own sample
+        assert ('mxtpu_requests_total{model="resnet",tenant="acme",'
+                'role="serving",rank="0"} 10') in page
+        assert ('mxtpu_requests_total{model="resnet",tenant="globex",'
+                'role="serving",rank="0"} 7') in page
+        # derived gauge: distinct models across the whole fleet
+        assert "mxtpu_fleet_models 3" in page
+    finally:
+        agg.stop()
+
+
 def test_proc_identity_follows_dmlc_contract(monkeypatch):
     from mxnet_tpu.telemetry.distributed import proc_identity, proc_label
 

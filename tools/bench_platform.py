@@ -1,0 +1,186 @@
+"""Multi-model platform benchmark: diurnal paging over one device pool.
+
+One process drives the whole platform lifecycle on CPU: N tiny models
+register on a pool with capacity for N/2, demand sweeps between the two
+halves for a few diurnal cycles, and every cycle pages the cold half
+out (writing AOT bundles) and faults the hot half in (warming from
+them).  A flooding tenant runs against the last cycle to measure
+per-tenant shedding isolation.
+
+Reported (ONE json line on stdout):
+
+* ``cold_fault_in_ms`` / ``warm_fault_in_ms`` — time from fault_in()
+  start to a routable warm server, first-ever (compiles) vs
+  bundle-backed (deserializes); ``warm_speedup`` is the ratio.
+* ``fault_ins`` / ``page_outs`` — actuation counts over the run.
+* ``warm_cold_bucket_runs`` — cold-bucket executions across every
+  bundle-backed fault-in (acceptance: 0).
+* ``tenant_p99_ms`` — per-tenant request p99 across the diurnal load.
+* ``noisy_shed`` / ``good_shed`` — admission rejections for the
+  flooding tenant vs its neighbours (acceptance: good_shed == 0).
+
+Usage: python tools/bench_platform.py [--models 6] [--cycles 3]
+       [--requests 40]
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _percentile(xs, q):
+    import numpy as np
+
+    return float(np.percentile(np.asarray(xs, np.float64), q)) if xs \
+        else None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", type=int, default=6,
+                    help="catalog size; the pool fits half of them")
+    ap.add_argument("--cycles", type=int, default=3,
+                    help="diurnal demand swings between the two halves")
+    ap.add_argument("--requests", type=int, default=40,
+                    help="requests per resident model per cycle")
+    cli = ap.parse_args(argv)
+
+    tmp = tempfile.mkdtemp(prefix="mxtpu-bench-platform-")
+    os.environ["MXNET_COMPILE_CACHE_DIR"] = os.path.join(tmp, "cache")
+    os.environ["MXNET_PLATFORM_MIN_RESIDENT_S"] = "0"
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.platform import (DevicePool, FrontDoor, ModelManager,
+                                    ModelSpec, TenantQuotaExceededError)
+
+    in_dim, hid = 8, 4
+    n = max(2, cli.models)
+    half = n // 2
+    tenants = ["acme", "blue", "good"]
+
+    rng = np.random.RandomState(7)
+    specs = []
+    for i in range(n):
+        # distinct hidden width per model: each one is a distinct XLA
+        # program, so a first fault-in genuinely compiles instead of
+        # riding a neighbour's cache entry
+        width = hid + i
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                    num_hidden=width, name="fc")
+        prefix = os.path.join(tmp, "m%d" % i)
+        params = {"fc_weight": mx.nd.array(
+                      rng.randn(width, in_dim).astype(np.float32)),
+                  "fc_bias": mx.nd.array(rng.randn(width)
+                                         .astype(np.float32))}
+        mx.model.save_checkpoint(prefix, 1, net, params, {})
+        specs.append(ModelSpec(
+            "m%d" % i, prefix, 1, {"data": (1, in_dim)},
+            tenant=tenants[i % len(tenants)], param_bytes=1000,
+            server_kwargs={"buckets": (1,), "max_wait_us": 500}))
+
+    # 20% headroom over the declared footprints: the live cost-analysis
+    # refinement nudges totals a little after first contact, and the
+    # pool must keep fitting `half` models (but never half+1)
+    total = specs[0].footprint()["total"]
+    pool = DevicePool(num_devices=1,
+                      bytes_per_device=int(half * total * 1.2))
+    lat_by_tenant = {}
+    cold_ms, warm_ms, warm_cold_runs = [], [], 0
+    x = np.zeros(in_dim, np.float32)
+
+    with ModelManager(pool) as mgr, FrontDoor(mgr) as door:
+        for s in specs:
+            mgr.register_model(s)
+
+        halves = [[s.name for s in specs[:half]],
+                  [s.name for s in specs[half:half * 2]]]
+        for cycle in range(cli.cycles):
+            hot = halves[cycle % 2]
+            for name in mgr.models():
+                d = mgr.demand()[name]
+                mgr.record_demand(name, (10.0 if name in hot else 0.0) - d)
+            mgr.replan()
+            for name in hot:
+                ms = mgr.fault_in_latency_ms(name)
+                if ms is None:
+                    continue
+                if cycle < 2:  # first visit of each half compiles
+                    cold_ms.append(ms)
+                else:
+                    warm_ms.append(ms)
+                    warm_cold_runs += \
+                        mgr.server_for(name).cold_bucket_runs()
+            for k in range(cli.requests):
+                name = hot[k % len(hot)]
+                tenant = mgr.spec(name).tenant
+                t0 = time.perf_counter()
+                door.predict(name, tenant=tenant, data=x)
+                lat_by_tenant.setdefault(tenant, []).append(
+                    (time.perf_counter() - t0) * 1e3)
+
+        # tenant flood against the final resident set: 'noisy' must be
+        # shed at the door while its neighbours' requests all land
+        door.quotas.set_quota("noisy", rate=50.0, burst=5.0)
+        victim = halves[(cli.cycles - 1) % 2][0]
+        noisy_shed = good_before_sheds = 0
+        t_end = time.monotonic() + 1.0
+        while time.monotonic() < t_end:
+            try:
+                door.predict(victim, tenant="noisy", data=x)
+            except TenantQuotaExceededError:
+                noisy_shed += 1
+            try:
+                t0 = time.perf_counter()
+                door.predict(victim, tenant="good", data=x)
+                lat_by_tenant.setdefault("good", []).append(
+                    (time.perf_counter() - t0) * 1e3)
+            except TenantQuotaExceededError:
+                good_before_sheds += 1
+
+        snap = door.quotas.snapshot()
+        fault_ins = page_outs = 0
+        from mxnet_tpu import telemetry
+
+        for line in telemetry.render_prometheus().splitlines():
+            if line.startswith("mxtpu_platform_fault_ins_total{"):
+                fault_ins += int(float(line.rsplit(None, 1)[1]))
+            elif line.startswith("mxtpu_platform_page_outs_total{"):
+                page_outs += int(float(line.rsplit(None, 1)[1]))
+
+    rec = {
+        "metric": "platform_warm_fault_in_ms",
+        "value": round(_percentile(warm_ms, 50) or 0.0, 2),
+        "unit": "ms",
+        "models": n,
+        "capacity_models": half,
+        "cycles": cli.cycles,
+        "cold_fault_in_ms": round(_percentile(cold_ms, 50) or 0.0, 2),
+        "warm_fault_in_ms": round(_percentile(warm_ms, 50) or 0.0, 2),
+        "warm_speedup": round(
+            _percentile(cold_ms, 50) / _percentile(warm_ms, 50), 2)
+        if cold_ms and warm_ms else None,
+        "fault_ins": fault_ins,
+        "page_outs": page_outs,
+        "warm_cold_bucket_runs": warm_cold_runs,
+        "tenant_p99_ms": {t: round(_percentile(v, 99), 2)
+                          for t, v in sorted(lat_by_tenant.items())},
+        "noisy_shed": noisy_shed,
+        "good_shed": snap.get("good", {}).get("shed", 0),
+    }
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps(rec))
+    return rec
+
+
+if __name__ == "__main__":
+    main()

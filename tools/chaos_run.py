@@ -987,12 +987,218 @@ def run_sdc_rollback(seed, timeout=120.0):
                 os.environ[k] = v
 
 
+def run_tenant_storm(seed, timeout=120.0, good_threads=2):
+    """Multi-tenant platform probe, in-process: a FrontDoor over a
+    ModelManager serves three models on a pool with room for two while
+    one tenant ('storm') floods its model in a tight loop and its
+    neighbours ('good0'/'good1') run steady interactive load.  Mid-storm
+    the victim model is paged out, then hard-killed mid-migration (its
+    server stopped out from under the router without deregistration) —
+    each time, demand paging must fault it back in WARM from its AOT
+    bundle.  Passes when the storm tenant was shed at the door (429s
+    with Retry-After), the good tenants saw ZERO quota sheds and zero
+    end-to-end failures, and every post-storm fault-in served with
+    ``cold_bucket_runs() == 0``."""
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.platform import (DevicePool, FrontDoor, ModelManager,
+                                    ModelSpec, TenantQuotaExceededError)
+
+    tmp = tempfile.mkdtemp(prefix="chaos-tenantstorm-")
+    envs = {"MXNET_COMPILE_CACHE_DIR": os.path.join(tmp, "cache"),
+            "MXNET_PLATFORM_MIN_RESIDENT_S": "0"}
+    prev = {k: os.environ.get(k) for k in envs}
+    os.environ.update(envs)
+
+    in_dim = 6
+    rng = np.random.RandomState(seed)
+    specs = []
+    for i, (name, tenant) in enumerate((("victim", "storm"),
+                                        ("good-a", "good0"),
+                                        ("good-b", "good1"))):
+        hid = 3 + i  # distinct programs: no cross-model cache riding
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                    num_hidden=hid, name="fc")
+        params = {"fc_weight": mx.nd.array(
+                      rng.randn(hid, in_dim).astype(np.float32)),
+                  "fc_bias": mx.nd.array(rng.randn(hid)
+                                         .astype(np.float32))}
+        prefix = os.path.join(tmp, name)
+        mx.model.save_checkpoint(prefix, 1, net, params, {})
+        specs.append(ModelSpec(
+            name, prefix, 1, {"data": (1, in_dim)}, tenant=tenant,
+            param_bytes=1000,
+            server_kwargs={"buckets": (1,), "max_wait_us": 1000,
+                           "max_queue": 256}))
+
+    total = specs[0].footprint()["total"]
+    pool = DevicePool(num_devices=1,
+                      bytes_per_device=int(2 * total * 1.2))
+    mgr = ModelManager(pool)
+    for s in specs:
+        mgr.register_model(s)
+    door = FrontDoor(mgr)
+    x = np.zeros(in_dim, np.float32)
+    stop_evt = threading.Event()
+    good_failures = []
+    good_served = [0]
+    storm_stats = {"admitted": 0, "shed": 0}
+    deadline = time.monotonic() + timeout
+    ok = True
+
+    def good_load(tid):
+        model = ("good-a", "good-b")[tid % 2]
+        tenant = ("good0", "good1")[tid % 2]
+        while not stop_evt.is_set():
+            t_req = time.monotonic() + 10.0
+            last = None
+            while time.monotonic() < min(t_req, deadline):
+                try:
+                    door.predict(model, tenant=tenant, deadline_ms=5000,
+                                 data=x)
+                    good_served[0] += 1
+                    last = None
+                    break
+                except TenantQuotaExceededError as exc:
+                    # a neighbour's flood must NEVER shed us — fatal
+                    good_failures.append("QUOTA:%r" % exc)
+                    return
+                except Exception as exc:  # dead replica mid-kill: retry
+                    last = exc
+                    time.sleep(0.02)
+            if last is not None:
+                good_failures.append(repr(last))
+                return
+            time.sleep(0.02)
+
+    def storm_load():
+        while not stop_evt.is_set():
+            try:
+                door.predict("victim", tenant="storm", deadline_ms=5000,
+                             data=x)
+                storm_stats["admitted"] += 1
+            except TenantQuotaExceededError as exc:
+                if exc.retry_after <= 0:
+                    good_failures.append("storm retry_after <= 0")
+                storm_stats["shed"] += 1
+            except Exception:
+                pass  # storm tenant gets no service guarantees
+
+    threads = [threading.Thread(target=good_load, args=(t,), daemon=True)
+               for t in range(good_threads)]
+    threads.append(threading.Thread(target=storm_load, daemon=True))
+    try:
+        # the storm tenant is rate-limited; its neighbours are not
+        door.quotas.set_quota("storm", rate=25.0, burst=5.0)
+        for name, d in (("victim", 5.0), ("good-a", 4.0)):
+            mgr.record_demand(name, d)
+        mgr.replan()  # victim + good-a resident; good-b demand-pages in
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+
+        # chaos 1: the victim model is paged out mid-storm — requests
+        # in flight drain, the next one demand-pages it back in warm
+        mgr.page_out("victim")
+        print("chaos_run: victim paged out mid-storm",
+              file=sys.stderr, flush=True)
+        time.sleep(1.0)
+
+        # chaos 2: hard-kill mid-migration — the victim's server dies
+        # out from under the router (no dereg, no drain), exactly what
+        # a preempted device looks like; the platform must recover it
+        srv = mgr.server_for("victim")
+        if srv is not None:
+            srv.stop(drain=False)
+        mgr.page_out("victim")  # reconcile the corpse
+        print("chaos_run: victim replica hard-killed mid-migration",
+              file=sys.stderr, flush=True)
+        time.sleep(1.5)
+        # in-quota storm traffic must have demand-paged the victim back
+        # in — WARM, from the bundle its first page-out wrote
+        srv = mgr.server_for("victim")
+        victim_cold_runs = None if srv is None else srv.cold_bucket_runs()
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=max(1.0, deadline - time.monotonic()))
+    finally:
+        stop_evt.set()
+        door.close()
+        mgr.close()
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    if good_failures:
+        print("chaos_run: good-tenant violations: %s"
+              % good_failures[:3], file=sys.stderr, flush=True)
+        ok = False
+    snap = door.quotas.snapshot()
+    for tenant in ("good0", "good1"):
+        if snap.get(tenant, {}).get("shed", 0):
+            print("chaos_run: tenant %s was shed by the storm" % tenant,
+                  file=sys.stderr, flush=True)
+            ok = False
+    if not storm_stats["shed"]:
+        print("chaos_run: storm tenant was never shed",
+              file=sys.stderr, flush=True)
+        ok = False
+    if not storm_stats["admitted"]:
+        print("chaos_run: storm tenant never got its in-quota share",
+              file=sys.stderr, flush=True)
+        ok = False
+    if victim_cold_runs != 0:
+        print("chaos_run: victim's post-kill fault-in was not warm "
+              "(cold_bucket_runs=%r)" % (victim_cold_runs,),
+              file=sys.stderr, flush=True)
+        ok = False
+    if good_served[0] < 20:
+        print("chaos_run: good tenants served only %d requests"
+              % good_served[0], file=sys.stderr, flush=True)
+        ok = False
+    # every fault-in after the first left/loaded an AOT bundle: the
+    # recovery path must have been warm (metrics survive close())
+    fault_ins = sum(
+        int(float(line.rsplit(None, 1)[1]))
+        for line in mgr.metrics.render_prometheus().splitlines()
+        if line.startswith("mxtpu_platform_fault_ins_total{"))
+    if fault_ins < 3:
+        print("chaos_run: expected >= 3 victim fault-ins, saw %d"
+              % fault_ins, file=sys.stderr, flush=True)
+        ok = False
+    if ok:
+        print("chaos_run: tenant-storm ok: good tenants served %d with "
+              "0 sheds and 0 failures through page-out + hard-kill; "
+              "storm admitted %d, shed %d; %d fault-ins"
+              % (good_served[0], storm_stats["admitted"],
+                 storm_stats["shed"], fault_ins),
+              file=sys.stderr, flush=True)
+        shutil.rmtree(tmp, ignore_errors=True)
+    else:
+        print("chaos_run: artifacts kept at %s" % tmp,
+              file=sys.stderr, flush=True)
+    return ok
+
+
 _SCENARIOS = {"membership-churn": run_membership_churn,
               "serving-failover": run_serving_failover,
               "flash-crowd": run_flash_crowd,
               "decode-storm": run_decode_storm,
               "sparse-replay": run_sparse_replay,
-              "sdc-rollback": run_sdc_rollback}
+              "sdc-rollback": run_sdc_rollback,
+              "tenant-storm": run_tenant_storm}
 
 
 def main():
