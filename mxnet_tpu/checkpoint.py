@@ -278,8 +278,11 @@ def attach_aot_bundle(prefix, epoch, mesh=None):
     :class:`MXNetError` when the bundle was built for a different device
     topology or mesh — a mismatched executable restore must fail loudly,
     not serve a wrong layout."""
-    from . import compile_cache
+    from . import compile_cache, faults
 
+    # chaos seam: checkpoint.aot.attach:ioerr=1 simulates a torn/unreadable
+    # bundle mid-fault-in (the platform leak-path drill)
+    faults.fire("checkpoint.aot.attach")
     path = aot_bundle_path(prefix, epoch)
     if not os.path.exists(os.path.join(path, compile_cache.MANIFEST_NAME)):
         return None

@@ -474,6 +474,23 @@ class DecodeEngine:
             # stop racing the loop): cancel whatever is left
             self._fail_all_locked(ServerClosedError("engine stopped"))
 
+    def handoff(self) -> int:
+        """Preempt every queued and active stream WITHOUT stopping the
+        engine: each fails with :class:`ServerClosedError`, which a
+        router-level consumer treats as a replica failure and re-submits
+        (prompt + emitted tokens) on a surviving replica — greedy decode
+        makes the resumed transcript bit-identical.  The graceful
+        page-out handoff: call this before the owning server releases
+        its device memory.  Returns the number of streams handed off."""
+        with self._cv:
+            n = len(self._pending) + len(self._active)
+            self._fail_all_locked(ServerClosedError(
+                "replica preempted: stream handed off"))
+            self._cv.notify_all()
+        if n:
+            _telemetry.log_event("gen_handoff", streams=n)
+        return n
+
     def _fail_all_locked(self, exc):
         n = 0
         for seq in list(self._pending) + list(self._active):

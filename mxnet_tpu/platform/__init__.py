@@ -23,23 +23,36 @@ shared pool serving many models for many tenants:
   rate limits plus weighted fair sharing under pressure, so one
   tenant's flood sheds THAT tenant (429 + Retry-After), never its
   neighbours.
+* :class:`HealthPlane` — failure-domain liveness: the pool's devices
+  group into host-sized domains probed via registry heartbeats and
+  injectable faults; K consecutive misses flip a domain dead, which
+  drives the manager's **degradation ladder** — reap dead replicas,
+  re-fault evicted models warm onto survivors, brown out lower SLO
+  classes (:class:`BrownoutError`, 503 + Retry-After) when not
+  everything fits, and gracefully page out the lowest-score models
+  (drained, streams handed off mid-generate, transcripts
+  bit-identical).
 * :class:`FrontDoor` — the multi-model request path: model name in the
   URL path or ``X-MXNet-Model`` header, tenant in ``X-Tenant``, routed
-  through per-model router views over one replica registry.
+  through per-model router views over one replica registry.  Arrivals
+  during a model's fault-in window get 503 + Retry-After with the
+  fault-in ETA (:class:`FaultInProgressError`).
 
 Every planner decision is a ``mxnet_tpu.faults`` dotted op
 (``platform.plan`` / ``platform.page_out`` / ``platform.fault_in`` /
-``platform.migrate``), so the chaos harness drives placement churn
-deterministically.
+``platform.migrate`` / ``platform.health.domain.<d>``), so the chaos
+harness drives placement churn and host loss deterministically.
 """
 from .spec import ModelSpec
 from .planner import DevicePool, PlacementPlan, PlacementPlanner
-from .quotas import TenantQuotaExceededError, TenantQuotas
-from .manager import ModelManager, PlatformMetrics
+from .quotas import BrownoutError, TenantQuotaExceededError, TenantQuotas
+from .healthplane import HealthPlane
+from .manager import FaultInProgressError, ModelManager, PlatformMetrics
 from .frontdoor import FrontDoor
 
 __all__ = [
     "ModelSpec", "DevicePool", "PlacementPlan", "PlacementPlanner",
-    "TenantQuotas", "TenantQuotaExceededError", "ModelManager",
-    "PlatformMetrics", "FrontDoor",
+    "TenantQuotas", "TenantQuotaExceededError", "BrownoutError",
+    "HealthPlane", "ModelManager", "PlatformMetrics",
+    "FaultInProgressError", "FrontDoor",
 ]

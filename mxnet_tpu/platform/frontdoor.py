@@ -14,7 +14,14 @@ EWMA, which is what earns a paged-out model its fault-in.
 
 A request for a paged-out model blocks on the fault-in (warm via the
 AOT bundle, so the stall is a bundle deserialize, not a compile) and
-then routes normally — demand paging, model edition.
+then routes normally — demand paging, model edition.  Only the FIRST
+such request pays that stall: while the model's fault-in window is open,
+later arrivals are rejected with 503 + ``Retry-After`` set to the
+remaining fault-in ETA (:class:`~.manager.FaultInProgressError`), and
+the queued-or-rejected decision is logged with the plan generation.
+When the degradation ladder has engaged brownout,
+:class:`~.quotas.BrownoutError` maps to the same 503 + ``Retry-After``
+family.
 """
 from __future__ import annotations
 
@@ -27,8 +34,9 @@ from ..serving.batcher import (DeadlineExceededError, QueueFullError,
                                ServerClosedError)
 from ..serving.router import (NoReplicaAvailableError, Router,
                               RouterOverloadError)
-from .manager import ModelManager
-from .quotas import TenantQuotaExceededError, TenantQuotas
+from .manager import FaultInProgressError, ModelManager
+from .quotas import BrownoutError, TenantQuotaExceededError, TenantQuotas
+from .spec import SLO_RANK
 
 __all__ = ["FrontDoor"]
 
@@ -58,6 +66,8 @@ class FrontDoor:
         self.manager = manager
         self.quotas = TenantQuotas(pressure_fn=self._pressure) \
             if quotas is None else quotas
+        # the degradation ladder browns this gate out on capacity loss
+        manager.bind_quotas(self.quotas)
         self._slo_classes = slo_classes
         self._sync_ms = float(registry_sync_ms)
         self._routers: Dict[str, Router] = {}
@@ -81,52 +91,88 @@ class FrontDoor:
 
     def _pressure(self) -> float:
         """Fleet pressure signal for the quota gate: worst live router.
-        Routers with no replicas yet report pressure 1.0 — a model
-        mid-fault-in must not trip fair-share shedding, so only routers
-        that actually have replicas count."""
+        Routers with no replicas — or only draining corpses mid-reap —
+        report pressure 1.0; a model mid-fault-in (or mid-host-loss)
+        must not trip fair-share shedding, so only routers with a
+        dispatchable replica count."""
         worst = 0.0
         for r in list(self._routers.values()):
-            if r.replicas():
+            if any(not rep.draining for rep in r.replicas()):
                 worst = max(worst, r.pressure())
         return worst
 
-    def _admit(self, model: str, tenant: str) -> Router:
+    def _resolve_slo(self, model: str, slo: Optional[str]) -> str:
+        """An explicit per-request ``slo`` wins; omitted, the request is
+        admitted as the MODEL's registered SLO class — a batch model's
+        tenant must not dodge a brownout by leaving the field blank."""
+        if slo:
+            return slo
+        try:
+            return self.manager.spec(model).slo
+        except Exception:
+            return "interactive"
+
+    def _admit(self, model: str, tenant: str,
+               slo: str = "interactive") -> Router:
         if self._closed:
             raise ServerClosedError("front door is closed")
-        self.quotas.admit(tenant)
+        self.quotas.admit(tenant, slo_rank=SLO_RANK.get(slo, 2))
         self.manager.record_demand(model)
         router = self.router_for(model)
         if self.manager.server_for(model) is None:
+            gen = self.manager.plan_generation()
+            eta = self.manager.fault_in_window(model)
+            if eta is not None:
+                # another request already owns the fault-in: shed with
+                # the remaining ETA instead of piling threads up behind
+                # the build
+                _telemetry.log_event(
+                    "platform_faultin_wait", model=model, tenant=tenant,
+                    decision="rejected", retry_after=round(eta, 3),
+                    gen=gen)
+                raise FaultInProgressError(
+                    "model %r is faulting in (plan gen %d); retry in "
+                    "%.2fs" % (model, gen, eta), retry_after=eta)
             # demand paging: fault the model in (warm, via its AOT
             # bundle) and make it routable before dispatching
+            _telemetry.log_event("platform_faultin_wait", model=model,
+                                 tenant=tenant, decision="queued",
+                                 gen=gen)
             self.manager.fault_in(model)
             router.sync_registry()
-        elif not any(not r.draining for r in router.replicas()):
+        elif not any(not r.draining and r.ready()
+                     for r in router.replicas()):
             # the model is resident (e.g. a replan faulted it in) but
-            # this router's 50ms background sync has not caught up yet
+            # this router's 50ms background sync has not caught up yet —
+            # a corpse handle awaiting removal does not count as caught
+            # up, or a post-host-loss re-fault stays unroutable for a
+            # full sync period
             router.sync_registry()
         return router
 
     def submit(self, model: str, tenant: str = "default",
-               slo: str = "interactive",
+               slo: Optional[str] = None,
                deadline_ms: Optional[float] = None, **inputs):
         """Admit + route one request; returns the router future.  Raises
         :class:`TenantQuotaExceededError` (tenant over quota / fair
         share) or :class:`RouterOverloadError` (fleet shed) — both the
-        429 family — synchronously."""
-        router = self._admit(model, tenant)
+        429 family — synchronously.  ``slo=None`` admits as the model's
+        registered SLO class."""
+        slo = self._resolve_slo(model, slo)
+        router = self._admit(model, tenant, slo=slo)
         return router.submit(slo=slo, deadline_ms=deadline_ms, **inputs)
 
     def predict(self, model: str, tenant: str = "default",
-                slo: str = "interactive",
+                slo: Optional[str] = None,
                 deadline_ms: Optional[float] = None, **inputs):
         return self.submit(model, tenant=tenant, slo=slo,
                            deadline_ms=deadline_ms, **inputs).result()
 
     def generate(self, model: str, prompt, max_new_tokens=None,
-                 tenant: str = "default", slo: str = "generate",
+                 tenant: str = "default", slo: Optional[str] = None,
                  deadline_ms: Optional[float] = None):
-        router = self._admit(model, tenant)
+        slo = self._resolve_slo(model, slo)
+        router = self._admit(model, tenant, slo=slo)
         return router.generate(prompt, max_new_tokens, slo=slo,
                                deadline_ms=deadline_ms)
 
@@ -234,7 +280,7 @@ class FrontDoor:
                         return
                     fut = door.submit(
                         model, tenant=tenant,
-                        slo=req.get("slo") or "interactive",
+                        slo=req.get("slo"),
                         deadline_ms=req.get("deadline_ms"),
                         **req.get("inputs", {}))
                     import numpy as np
@@ -246,6 +292,12 @@ class FrontDoor:
                 except (TenantQuotaExceededError,
                         RouterOverloadError) as exc:
                     self._reply(429, json.dumps({"error": str(exc)}),
+                                headers=(("Retry-After", "%g"
+                                          % exc.retry_after),))
+                except (FaultInProgressError, BrownoutError) as exc:
+                    # the platform is coming up / running degraded:
+                    # retryable, with an honest ETA
+                    self._reply(503, json.dumps({"error": str(exc)}),
                                 headers=(("Retry-After", "%g"
                                           % exc.retry_after),))
                 except DeadlineExceededError as exc:
@@ -261,7 +313,7 @@ class FrontDoor:
                 it = door.generate(
                     model, req.get("prompt", []),
                     req.get("max_new_tokens"), tenant=tenant,
-                    slo=req.get("slo") or "generate",
+                    slo=req.get("slo"),
                     deadline_ms=req.get("deadline_ms"))
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")

@@ -20,6 +20,15 @@ while its neighbours keep their SLOs.  Two mechanisms compose here:
 Both paths raise :class:`TenantQuotaExceededError`, which the front
 door maps to HTTP 429 + ``Retry-After`` exactly like the router's
 :class:`~mxnet_tpu.serving.router.RouterOverloadError`.
+
+A third, platform-driven gate is **brownout**: when the degradation
+ladder sheds capacity (a failure domain died and not every model fits
+the survivors), the manager calls :meth:`TenantQuotas.set_brownout` with
+the highest SLO rank still admitted.  Requests of lower-priority
+classes raise :class:`BrownoutError` — the 503 + ``Retry-After``
+family, distinct from the tenant's own 429s: the *platform* is degraded,
+not the tenant misbehaving.  One recovery or successful re-plan clears
+it via :meth:`TenantQuotas.clear_brownout`.
 """
 from __future__ import annotations
 
@@ -30,7 +39,7 @@ from typing import Dict, Optional
 from .. import telemetry as _telemetry
 from ..base import MXNetError, env, register_env
 
-__all__ = ["TenantQuotas", "TenantQuotaExceededError"]
+__all__ = ["TenantQuotas", "TenantQuotaExceededError", "BrownoutError"]
 
 register_env("MXNET_PLATFORM_TENANT_RATE", 0.0, float,
              "Default per-tenant admission rate limit in requests/s "
@@ -43,6 +52,10 @@ register_env("MXNET_PLATFORM_FAIR_PRESSURE", 0.75, float,
              "Fleet queue-pressure fraction beyond which per-tenant "
              "weighted fair-share shedding engages (tenants above their "
              "share are 429d; tenants inside it are never shed).")
+register_env("MXNET_PLATFORM_BROWNOUT_RETRY_S", 2.0, float,
+             "Retry-After the brownout gate attaches to 503s for SLO "
+             "classes shed while the platform runs degraded on a "
+             "partial device pool.")
 
 _EWMA_ALPHA = 0.2
 
@@ -56,9 +69,20 @@ class TenantQuotaExceededError(MXNetError):
         self.retry_after = retry_after
 
 
+class BrownoutError(MXNetError):
+    """Platform-degraded admission rejection (a failure domain is down
+    and this request's SLO class is below the brownout floor) — HTTP 503
+    + Retry-After.  Distinct from :class:`TenantQuotaExceededError`: the
+    platform is shedding, not the tenant flooding."""
+
+    def __init__(self, msg, retry_after=2.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
 class _Tenant:
     __slots__ = ("rate", "burst", "weight", "tokens", "last_refill",
-                 "ewma_rps", "last_seen", "admitted", "shed")
+                 "ewma_rps", "last_seen", "admitted", "shed", "browned")
 
     def __init__(self, rate, burst, weight):
         self.rate = rate
@@ -70,6 +94,7 @@ class _Tenant:
         self.last_seen = self.last_refill
         self.admitted = 0
         self.shed = 0
+        self.browned = 0
 
 
 class TenantQuotas:
@@ -85,6 +110,7 @@ class TenantQuotas:
             if fair_pressure is None else float(fair_pressure))
         self._default_rate = env("MXNET_PLATFORM_TENANT_RATE", 0.0, float)
         self._default_burst = env("MXNET_PLATFORM_TENANT_BURST", 32.0, float)
+        self._brownout = None  # (max_admitted_rank, plan_gen, retry_after)
 
     def set_quota(self, tenant: str, rate: Optional[float] = None,
                   burst: Optional[float] = None, weight: float = 1.0):
@@ -118,14 +144,59 @@ class TenantQuotas:
                           _EWMA_ALPHA * inst
                           + (1 - _EWMA_ALPHA) * t.ewma_rps)
 
-    def admit(self, tenant: str = "default"):
+    # -- brownout (degradation-ladder rung 2) ------------------------------
+    def set_brownout(self, max_rank: int, gen: int = 0,
+                     retry_after: Optional[float] = None):
+        """Engage brownout: only requests whose SLO rank is <=
+        ``max_rank`` are admitted (rank 0 = interactive; see
+        ``spec.SLO_RANK``).  ``gen`` is the plan generation that caused
+        it, stamped on shed events."""
+        retry = (env("MXNET_PLATFORM_BROWNOUT_RETRY_S", 2.0, float)
+                 if retry_after is None else float(retry_after))
+        with self._lock:
+            prev = self._brownout
+            self._brownout = (int(max_rank), int(gen), retry)
+        if prev is None or prev[:2] != (int(max_rank), int(gen)):
+            _telemetry.log_event("platform_brownout", engaged=True,
+                                 max_rank=int(max_rank), gen=int(gen))
+
+    def clear_brownout(self, gen: int = 0):
+        with self._lock:
+            prev = self._brownout
+            self._brownout = None
+        if prev is not None:
+            _telemetry.log_event("platform_brownout", engaged=False,
+                                 gen=int(gen))
+
+    def brownout(self):
+        """The active ``(max_rank, gen, retry_after)`` or None."""
+        with self._lock:
+            return self._brownout
+
+    def admit(self, tenant: str = "default", slo_rank=None):
         """Admit one request for ``tenant`` or raise
-        :class:`TenantQuotaExceededError`.  Never raises for tenants
-        inside both their rate ceiling and their fair share."""
+        :class:`TenantQuotaExceededError` (over quota / fair share — the
+        tenant's fault, 429) or :class:`BrownoutError` (platform
+        degraded and ``slo_rank`` is below the brownout floor — 503).
+        Never raises for tenants inside both their rate ceiling and
+        their fair share while the platform is whole.  ``slo_rank`` None
+        bypasses the brownout gate (legacy callers)."""
         now = time.monotonic()
         with self._lock:
             t = self._tenant_locked(tenant)
             self._observe_locked(t, now)
+            b = self._brownout
+            if b is not None and slo_rank is not None \
+                    and int(slo_rank) > b[0]:
+                t.browned += 1
+                _telemetry.log_event(
+                    "platform_quota_shed", tenant=tenant,
+                    reason="brownout", slo_rank=int(slo_rank),
+                    max_rank=b[0], gen=b[1])
+                raise BrownoutError(
+                    "platform degraded (plan gen %d): SLO rank %d not "
+                    "admitted during brownout (floor %d)"
+                    % (b[1], int(slo_rank), b[0]), retry_after=b[2])
             # hard ceiling first: refill, then spend
             if t.rate > 0:
                 t.tokens = min(t.burst,
@@ -164,6 +235,7 @@ class TenantQuotas:
     def snapshot(self) -> dict:
         with self._lock:
             return {name: {"admitted": t.admitted, "shed": t.shed,
+                           "browned": t.browned,
                            "rate": t.rate, "weight": t.weight,
                            "ewma_rps": round(t.ewma_rps, 2)}
                     for name, t in self._tenants.items()}

@@ -58,11 +58,16 @@ class ModelSpec:
     server_kwargs : dict, optional
         Extra ``InferenceServer.from_checkpoint`` kwargs (buckets,
         max_queue, ...).
+    replicas : int
+        Desired replica count (default 1).  The planner spreads a
+        model's replicas across failure domains, so losing one host
+        degrades capacity instead of availability; each replica costs
+        one full footprint.
     """
 
     __slots__ = ("name", "prefix", "epoch", "input_shapes", "tenant",
                  "slo", "weight", "generator_spec", "server_kwargs",
-                 "_param_bytes", "_measured_exec_bytes")
+                 "replicas", "_param_bytes", "_measured_exec_bytes")
 
     def __init__(self, name: str, prefix: str, epoch: int,
                  input_shapes: Dict[str, Sequence[int]],
@@ -70,13 +75,16 @@ class ModelSpec:
                  weight: float = 1.0,
                  generator_spec: Optional[dict] = None,
                  param_bytes: Optional[int] = None,
-                 server_kwargs: Optional[dict] = None):
+                 server_kwargs: Optional[dict] = None,
+                 replicas: int = 1):
         if not name or "/" in name:
             raise MXNetError("model name must be non-empty and slash-free, "
                              "got %r" % (name,))
         if slo not in SLO_RANK:
             raise MXNetError("unknown SLO class %r (one of %s)"
                              % (slo, sorted(SLO_RANK)))
+        if int(replicas) < 1:
+            raise MXNetError("replicas must be >= 1, got %r" % (replicas,))
         self.name = name
         self.prefix = prefix
         self.epoch = int(epoch)
@@ -86,6 +94,7 @@ class ModelSpec:
         self.weight = float(weight)
         self.generator_spec = dict(generator_spec) if generator_spec else None
         self.server_kwargs = dict(server_kwargs) if server_kwargs else {}
+        self.replicas = int(replicas)
         self._param_bytes = None if param_bytes is None else int(param_bytes)
         self._measured_exec_bytes = None
 
@@ -146,7 +155,8 @@ class ModelSpec:
         d = self.footprint()
         d.update(name=self.name, tenant=self.tenant, slo=self.slo,
                  weight=self.weight, prefix=self.prefix, epoch=self.epoch,
-                 generate=self.generator_spec is not None)
+                 generate=self.generator_spec is not None,
+                 replicas=self.replicas)
         return d
 
     def __repr__(self):

@@ -255,6 +255,21 @@ class MicroBatcher:
         with self._cv:
             return len(self._q)
 
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until the queue is empty AND no dequeued batch is still
+        executing — the drain barrier a graceful page-out waits on before
+        releasing device memory.  Returns False on timeout (workers may
+        re-check on a short poll: completions do not notify the CV)."""
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        while True:
+            with self._cv:
+                if not self._q and not self._inflight:
+                    return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+
     def dead_workers(self):
         """``["thread-name: exception", ...]`` for worker threads that died
         on an unexpected error (health endpoints report these as degraded

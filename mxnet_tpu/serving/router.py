@@ -103,6 +103,10 @@ register_env("MXNET_SERVING_PROBE_FAILURES", 3, int,
              "Consecutive background-probe failures before a remote "
              "replica's cached health/readiness flips to down — one "
              "slow /healthz under load must not flap the breaker.")
+register_env("MXNET_ROUTER_PROBE_FAILS", 0, int,
+             "Consecutive health-probe failures before the router marks "
+             "a backend dead (recovery still takes one success); 0 "
+             "defers to MXNET_SERVING_PROBE_FAILURES (default 3).")
 register_env("MXNET_SERVING_REGISTRY_SYNC_MS", 500.0, float,
              "Period at which a registry-attached router re-syncs its "
              "replica set against the shared live set.")
@@ -557,7 +561,8 @@ class _RemoteReplica(_Replica):
         # debounce: one slow /healthz under load must not flap the
         # replica out of rotation — K consecutive failures flip it down,
         # one success flips it straight back up
-        self._probe_k = max(1, env("MXNET_SERVING_PROBE_FAILURES", 3, int))
+        self._probe_k = max(1, env("MXNET_ROUTER_PROBE_FAILS", 0, int)
+                            or env("MXNET_SERVING_PROBE_FAILURES", 3, int))
         self._alive_misses = 0
         self._ready_misses = 0
 

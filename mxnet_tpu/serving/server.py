@@ -118,23 +118,13 @@ class InferenceServer:
         self._dtype = np.dtype(dtype)
         ctxs = ctx if isinstance(ctx, (list, tuple)) else [ctx]
         self._ctxs = list(ctxs)
-        self._replicas = [
-            BucketedPredictor(symbol, params, self._item_shapes, buckets,
-                              ctx=c, dtype=dtype)
-            for c in ctxs]
-        self.buckets = self._replicas[0].buckets
-        self.metrics = ServingMetrics()
-        self._batcher = MicroBatcher(
-            self._replicas, self.metrics,
-            max_wait_us=env("MXNET_SERVING_MAX_WAIT_US", 2000, int)
-            if max_wait_us is None else max_wait_us,
-            max_queue=env("MXNET_SERVING_MAX_QUEUE", 256, int)
-            if max_queue is None else max_queue)
-        # snapshots that must survive a post-stop release (swap_config and
-        # the router's capacity estimate read these, possibly on a server
-        # whose predictors were already dropped by page-out)
-        self._max_wait_us = self._batcher.max_wait_us
-        self._max_queue = self._batcher.max_queue
+        # release-relevant state BEFORE any device allocation, so a
+        # mid-construction failure can unwind whatever was built
+        self._replicas = []
+        self._batcher = None
+        self._generator = None
+        self._generator_spec = None
+        self._model_params = params
         self._released_cold_runs = 0
         self._httpd = None
         self._http_thread = None
@@ -145,24 +135,68 @@ class InferenceServer:
         self._draining = False
         self._stopped = False
         self._swap_lock = threading.Lock()
-        # generative sidecar: a DecodeEngine sharing this checkpoint's
-        # params, driving POST /generate token streaming
-        self._generator = None
-        self._generator_spec = None
-        self._model_params = params
-        if generator_spec is not None:
-            from ..generation import DecodeEngine
+        try:
+            self._replicas = [
+                BucketedPredictor(symbol, params, self._item_shapes,
+                                  buckets, ctx=c, dtype=dtype)
+                for c in ctxs]
+            self.buckets = self._replicas[0].buckets
+            self.metrics = ServingMetrics()
+            self._batcher = MicroBatcher(
+                self._replicas, self.metrics,
+                max_wait_us=env("MXNET_SERVING_MAX_WAIT_US", 2000, int)
+                if max_wait_us is None else max_wait_us,
+                max_queue=env("MXNET_SERVING_MAX_QUEUE", 256, int)
+                if max_queue is None else max_queue)
+            # snapshots that must survive a post-stop release (swap_config
+            # and the router's capacity estimate read these, possibly on a
+            # server whose predictors were already dropped by page-out)
+            self._max_wait_us = self._batcher.max_wait_us
+            self._max_queue = self._batcher.max_queue
+            # generative sidecar: a DecodeEngine sharing this checkpoint's
+            # params, driving POST /generate token streaming
+            if generator_spec is not None:
+                from ..generation import DecodeEngine
 
-            self.attach_generator(DecodeEngine(
-                params, warmup=warmup, start=start, ctx=self._ctxs[0],
-                dtype=dtype, **generator_spec))
-        # warmup=False is an explicit opt-out (lazy compiles): the server
-        # counts as warmed-for-readiness the moment it starts
-        self._warmed = not warmup
-        if warmup:
-            self.warmup()
-        if start:
-            self.start()
+                self.attach_generator(DecodeEngine(
+                    params, warmup=warmup, start=start, ctx=self._ctxs[0],
+                    dtype=dtype, **generator_spec))
+            # warmup=False is an explicit opt-out (lazy compiles): the
+            # server counts as warmed-for-readiness the moment it starts
+            self._warmed = not warmup
+            if warmup:
+                self.warmup()
+            if start:
+                self.start()
+        except BaseException:
+            self._abort_partial_build()
+            raise
+
+    def _abort_partial_build(self):
+        """Unwind a construction that failed midway (a torn AOT bundle, a
+        fault-injected warmup IOError): stop whatever threads already run
+        and drop every device-memory reference, so the failed attempt pins
+        nothing — ``resident_bytes()`` of the owner returns to its
+        pre-attempt value instead of leaking a half-built replica through
+        a live DecodeEngine loop thread."""
+        self._stopped = True
+        self._draining = True
+        gen = self._generator
+        if gen is not None:
+            try:
+                gen.stop(drain=False, timeout=5.0)
+            except Exception:
+                pass
+        batcher = self._batcher
+        if batcher is not None:
+            try:
+                batcher.stop(drain=False, timeout=5.0)
+                batcher.release()
+            except Exception:
+                pass
+        self._replicas = []
+        self._generator = None
+        self._model_params = None
 
     @classmethod
     def from_checkpoint(cls, prefix, epoch, input_shapes, attach_aot=True,
@@ -266,6 +300,12 @@ class InferenceServer:
         """Pre-compile every bucket on every replica.  The server is not
         :meth:`ready` until this completes (callers deferring warmup past
         construction get the ``/readyz`` 503-while-warming window)."""
+        from .. import faults
+
+        # chaos seam: serving.server.warmup:ioerr=1 fails the warmup after
+        # the predictors (and a generator) are device-resident — the
+        # partial-allocation path _abort_partial_build must unwind
+        faults.fire("serving.server.warmup")
         self._warmed = False
         for rep in self._replicas:
             rep.warmup()
@@ -365,6 +405,25 @@ class InferenceServer:
 
     def queue_depth(self):
         return self._batcher.queue_depth()
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until the batcher queue is empty and no dequeued batch
+        is still executing — the graceful page-out drain barrier (call
+        :meth:`begin_drain` first so no new work arrives).  False on
+        timeout."""
+        if self._batcher is None:
+            return True
+        return self._batcher.wait_idle(timeout)
+
+    def handoff_streams(self) -> int:
+        """Fail every queued and active generate stream with
+        :class:`ServerClosedError` so a router-level consumer re-homes
+        them on a surviving replica (greedy decode resumes bit-identical
+        from prompt + emitted tokens).  Returns the stream count; 0
+        without a generator."""
+        if self._generator is None:
+            return 0
+        return self._generator.handoff()
 
     def health(self):
         """``("ok", [])`` when every replica worker is alive, else

@@ -42,7 +42,8 @@ from .step_monitor import (RecompileWarning, StepMonitor, fused_cost_analysis,
 __all__ = [
     "enabled", "enable", "disable", "dump_dir", "registry", "counter",
     "gauge",
-    "histogram", "labeled_counter", "log_event", "events", "event_log",
+    "histogram", "labeled_counter", "log_event", "events", "events_of",
+    "event_log",
     "span", "dump_trace", "merged_trace", "validate_trace",
     "render_prometheus", "register_collector", "summary",
     "current_step_monitor", "Registry", "Counter", "Gauge", "Histogram",
@@ -156,6 +157,14 @@ def log_event(kind, **fields):
 
 def events(n=None):
     return event_log().tail(n) if _event_log is not None else []
+
+
+def events_of(kind, n=None):
+    """The tail of the structured-event log filtered to one ``kind`` —
+    what chaos scenarios and tests assert platform transitions against
+    (e.g. ``platform_domain_health``, ``platform_brownout``)."""
+    out = [e for e in events() if e.get("kind") == kind]
+    return out if n is None else out[-int(n):]
 
 
 _atexit_hooked = False

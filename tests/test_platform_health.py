@@ -1,0 +1,474 @@
+"""Failure-domain platform tests — health plane debounce, domain-aware
+replica spread, the degradation ladder (reap / warm re-fault / brownout /
+graceful page-out), leak-free fault-in failure, fault-in-window 503s, and
+concurrent page-out vs in-flight traffic.  All CPU-only with tiny
+explicit pools; host death is simulated by stopping heartbeats (TTL
+eviction) or injected probe faults — never by real process kills."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, serving, telemetry
+from mxnet_tpu.platform import (BrownoutError, DevicePool,
+                                FaultInProgressError, FrontDoor,
+                                HealthPlane, ModelManager, ModelSpec,
+                                PlacementPlanner)
+from mxnet_tpu.serving.batcher import ServerClosedError
+from mxnet_tpu.serving.registry import ReplicaRegistry
+from mxnet_tpu.serving.router import NoReplicaAvailableError, Router
+
+IN_DIM = 4
+
+
+@pytest.fixture(autouse=True)
+def _platform_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    monkeypatch.setenv("MXNET_PLATFORM_MIN_RESIDENT_S", "0")
+    telemetry._reset_for_tests()
+    yield
+    telemetry._reset_for_tests()
+
+
+def _save_fc(tmp_path, name, seed=0, in_dim=IN_DIM, hid=2):
+    rng = np.random.RandomState(seed)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=hid,
+                                name="fc")
+    params = {
+        "fc_weight": mx.nd.array(rng.randn(hid, in_dim).astype(np.float32)),
+        "fc_bias": mx.nd.array(rng.randn(hid).astype(np.float32)),
+    }
+    prefix = str(tmp_path / name)
+    mx.model.save_checkpoint(prefix, 1, net, params, {})
+    return prefix, {"data": (1, in_dim)}
+
+
+def _fc_spec(tmp_path, name, **kw):
+    prefix, shapes = _save_fc(tmp_path, name, seed=sum(map(ord, name)) % 97)
+    kw.setdefault("param_bytes", 1000)
+    kw.setdefault("server_kwargs", {"buckets": (1,)})
+    return ModelSpec(name, prefix, 1, shapes, **kw)
+
+
+def _spec(name, pbytes=100, **kw):
+    return ModelSpec(name, "/nonexistent/%s" % name, 1,
+                     {"data": (1, IN_DIM)}, param_bytes=pbytes, **kw)
+
+
+def _tiny_server(seed=0):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                name="fc")
+    rng = np.random.RandomState(seed)
+    params = {"fc_weight": mx.nd.array(rng.randn(2, IN_DIM)
+                                       .astype(np.float32)),
+              "fc_bias": mx.nd.array(rng.randn(2).astype(np.float32))}
+    return serving.InferenceServer(net, params, {"data": (1, IN_DIM)},
+                                   buckets=(1,), warmup=False)
+
+
+# -- device pool domains -----------------------------------------------------
+
+def test_pool_failure_domains():
+    pool = DevicePool(num_devices=5, bytes_per_device=100,
+                      devices_per_host=2)
+    assert pool.num_domains == 3
+    assert [pool.domain_of(d) for d in range(5)] == [0, 0, 1, 1, 2]
+    assert pool.devices_in(1) == [2, 3]
+    assert pool.devices_in(2) == [4]  # ragged last host
+    # default: one domain holds everything
+    assert DevicePool(num_devices=4, bytes_per_device=1).num_domains == 1
+
+
+# -- health plane ------------------------------------------------------------
+
+def test_healthplane_registry_debounce_and_recovery():
+    """A dead host does not deregister — its heartbeats stop and TTL
+    eviction empties its domain.  K consecutive empty probes flip the
+    domain down; recovery needs positive heartbeat evidence.  A domain
+    that never held replicas is idle, not dead."""
+    pool = DevicePool(num_devices=2, bytes_per_device=100,
+                      devices_per_host=1)
+    reg = ReplicaRegistry(ttl_ms=80)
+    srv = _tiny_server()
+    seen = []
+    hp = HealthPlane(pool, registry=reg, probe_fails=2,
+                     on_change=lambda d, up: seen.append((d, up)))
+    try:
+        reg.register("m/r1", srv, meta={"model": "m", "device": 0})
+        assert hp.probe() == []
+        assert hp.alive_devices() == [0, 1]
+
+        # heartbeats stop; the entry TTL-evicts; two misses flip dom 0
+        time.sleep(0.12)
+        assert hp.probe() == []  # miss 1: debounced
+        assert hp.probe() == [(0, False)]
+        assert hp.dead_domains() == [0]
+        assert hp.alive_devices() == [1]  # dom 1 never had replicas: idle
+        assert not hp.is_alive(0) and hp.is_alive(1)
+        assert hp.probe() == []  # still down, no flapping
+
+        # recovery requires a replica heartbeating from the domain again
+        reg.register("m/r2", srv, meta={"model": "m", "device": 0})
+        assert hp.probe() == [(0, True)]
+        assert hp.alive_devices() == [0, 1]
+        assert seen == [(0, False), (0, True)]
+    finally:
+        reg.close()
+        srv.stop(drain=False)
+
+
+def test_healthplane_fault_injected_domain_kill_and_marks():
+    pool = DevicePool(num_devices=4, bytes_per_device=100,
+                      devices_per_host=2)
+    hp = HealthPlane(pool, probe_fails=1)
+    with faults.inject("platform.health.domain.1:ioerr=1", seed=7):
+        assert hp.probe() == [(1, False)]
+    assert hp.alive_devices() == [0, 1]
+    # without a registry, a clean sweep is recovery evidence enough
+    assert hp.probe() == [(1, True)]
+    hp.mark_down(0)
+    assert hp.dead_domains() == [0]
+    hp.mark_up(0)
+    assert hp.dead_domains() == []
+    assert hp.describe()["domains"][0]["alive"]
+
+
+# -- planner: replica spread + dead capacity ---------------------------------
+
+def test_planner_spreads_replicas_across_domains():
+    pool = DevicePool(num_devices=4, bytes_per_device=300,
+                      devices_per_host=2)
+    specs = {"m": _spec("m", pbytes=160, replicas=2)}  # total 200
+    plan = PlacementPlanner(pool).plan(specs, {"m": 1.0})
+    placed = plan.replica_devices["m"]
+    assert len(placed) == 2
+    doms = {pool.domain_of(d) for d in placed.values()}
+    assert doms == {0, 1}  # one host lost => one replica lost, not both
+    assert all("replica" in a for a in plan.actions)
+    # both replicas fit one host when the other is dead: capacity over
+    # availability once there is nothing left to spread across
+    plan = PlacementPlanner(pool).plan(specs, {"m": 1.0},
+                                       alive_devices=[0, 1])
+    placed = plan.replica_devices["m"]
+    assert len(placed) == 2
+    assert {pool.domain_of(d) for d in placed.values()} == {0}
+
+
+def test_planner_excludes_dead_devices_and_migrates_off_them():
+    pool = DevicePool(num_devices=2, bytes_per_device=300,
+                      devices_per_host=1)
+    specs = {"a": _spec("a", pbytes=160)}
+    # 'a' sits on device 0; host 0 dies; the plan moves it to device 1
+    plan = PlacementPlanner(pool).plan(specs, {"a": 1.0}, current={"a": 0},
+                                       alive_devices=[1])
+    assert plan.resident == {"a": 1}
+    assert {"op": "migrate", "model": "a", "src": 0, "dst": 1} \
+        in plan.actions
+    # nothing alive: everything is planned paged
+    plan = PlacementPlanner(pool).plan(specs, {"a": 1.0}, current={"a": 0},
+                                       alive_devices=[])
+    assert plan.paged == ["a"]
+
+
+# -- manager: multi-replica lifecycle ----------------------------------------
+
+def test_manager_two_replicas_and_selective_page_out(tmp_path):
+    pool = DevicePool(num_devices=2, bytes_per_device=1 << 20,
+                      devices_per_host=1)
+    with ModelManager(pool) as mgr:
+        mgr.register_model(_fc_spec(tmp_path, "dup", replicas=2))
+        s0 = mgr.fault_in("dup", 0, replica=0)
+        s1 = mgr.fault_in("dup", 1, replica=1)
+        assert s0 is not s1
+        assert mgr.replica_placement() == {"dup": {0: 0, 1: 1}}
+        assert mgr.placement() == {"dup": 0}  # primary view
+        metas = mgr.registry.live()["meta"]
+        assert {m["replica"] for m in metas.values()} == {0, 1}
+        assert {m["device"] for m in metas.values()} == {0, 1}
+
+        mgr.page_out("dup", replica=1)
+        assert mgr.replica_placement() == {"dup": {0: 0}}
+        assert mgr.server_for("dup") is s0
+        assert len(mgr.registry.live()["replicas"]) == 1
+
+        # the survivor keeps serving; a full page-out clears everything
+        s0.submit(data=np.zeros(IN_DIM, np.float32)).result()
+        mgr.page_out("dup")
+        assert mgr.resident_bytes() == 0
+        assert mgr.server_for("dup") is None
+
+
+def test_manager_kill_replica_leaves_control_plane_stale(tmp_path,
+                                                         monkeypatch):
+    """kill_replica is a dead host: serving stops, heartbeats stop, but
+    the manager still believes the replica is placed until the health
+    plane reaps it — exactly the window the ladder closes."""
+    # beats faster than the TTL, so only the CORPSE evicts
+    monkeypatch.setenv("MXNET_SERVING_REGISTRY_HEARTBEAT_MS", "20")
+    pool = DevicePool(num_devices=2, bytes_per_device=1 << 20,
+                      devices_per_host=1)
+    reg = ReplicaRegistry(ttl_ms=150)
+    with ModelManager(pool, registry=reg) as mgr:
+        mgr.register_model(_fc_spec(tmp_path, "vic", replicas=2))
+        mgr.fault_in("vic", 0, replica=0)
+        s1 = mgr.fault_in("vic", 1, replica=1)
+        assert mgr.kill_replica("vic", replica=0)
+        assert not mgr.kill_replica("ghost")  # unknown: False, no raise
+        # control plane still lists both replicas...
+        assert mgr.replica_placement() == {"vic": {0: 0, 1: 1}}
+        # ...but server_for skips the corpse
+        assert mgr.server_for("vic") is s1
+        # and the corpse's registry entry TTL-evicts (no deregister)
+        time.sleep(0.25)
+        assert set(reg.live()["replicas"]) == {"vic/r2"}
+
+
+# -- satellite 2: fault-in failure leaks nothing -----------------------------
+
+def test_fault_in_failure_releases_partial_allocation(tmp_path):
+    pool = DevicePool(num_devices=1, bytes_per_device=1 << 20)
+    with ModelManager(pool) as mgr:
+        mgr.register_model(_fc_spec(tmp_path, "torn"))
+        baseline = mgr.resident_bytes()
+        # warmup fires AFTER params land on device: the worst leak path
+        with faults.inject("serving.server.warmup:ioerr=1", seed=3):
+            with pytest.raises(OSError):
+                mgr.fault_in("torn")
+        assert mgr.resident_bytes() == baseline
+        assert mgr.server_for("torn") is None
+        assert mgr.fault_in_window("torn") is None  # window closed
+        assert mgr.registry.live()["replicas"] == {}
+        # torn AOT bundle read (the ISSUE's named injection point)
+        with faults.inject("checkpoint.aot.attach:ioerr=1", seed=3):
+            with pytest.raises(OSError):
+                mgr.fault_in("torn")
+        assert mgr.resident_bytes() == baseline
+        # the retry succeeds and serves
+        srv = mgr.fault_in("torn")
+        srv.submit(data=np.zeros(IN_DIM, np.float32)).result()
+        assert mgr.resident_bytes() > baseline
+    text = telemetry.render_prometheus()
+    assert 'mxtpu_platform_fault_in_failures_total{model="torn"} 2' in text
+
+
+# -- satellite 1: 503 + Retry-After during the fault-in window ---------------
+
+def test_frontdoor_rejects_during_fault_in_window(tmp_path):
+    telemetry.enable()
+    pool = DevicePool(num_devices=1, bytes_per_device=1 << 20)
+    with ModelManager(pool) as mgr, FrontDoor(mgr) as door:
+        mgr.register_model(_fc_spec(tmp_path, "slowm"))
+        errs = []
+
+        def owner():
+            try:
+                with faults.inject("platform.fault_in:delay=1@0.6", seed=1):
+                    mgr.fault_in("slowm")
+            except Exception as exc:  # pragma: no cover
+                errs.append(exc)
+
+        t = threading.Thread(target=owner)
+        t.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while mgr.fault_in_window("slowm") is None:
+                assert time.monotonic() < deadline, "window never opened"
+                time.sleep(0.005)
+            with pytest.raises(FaultInProgressError) as ei:
+                door.predict("slowm", data=np.zeros(IN_DIM, np.float32))
+            assert ei.value.retry_after > 0
+        finally:
+            t.join()
+        assert not errs
+        assert mgr.fault_in_window("slowm") is None
+        # after the window closes, the same request serves normally
+        out = door.predict("slowm", data=np.zeros(IN_DIM, np.float32))
+        assert np.asarray(out[0]).shape == (2,)
+        evs = telemetry.events_of("platform_faultin_wait")
+        assert [e["decision"] for e in evs] == ["rejected"]
+        assert evs[0]["retry_after"] > 0 and "gen" in evs[0]
+
+
+# -- the degradation ladder --------------------------------------------------
+
+def test_degradation_ladder_brownout_and_recovery(tmp_path):
+    """Host loss with two single-device hosts: the interactive model is
+    re-faulted warm onto the survivor (rung 1), the batch model is paged
+    out (rung 3), and the door browns out the batch class (rung 2) until
+    the host returns."""
+    telemetry.enable()
+    pool = DevicePool(num_devices=2, bytes_per_device=1300,
+                      devices_per_host=1)
+    reg = ReplicaRegistry(ttl_ms=60_000)
+    with ModelManager(pool, registry=reg) as mgr, FrontDoor(mgr) as door:
+        hp = mgr.attach_health(HealthPlane(pool, registry=reg,
+                                           probe_fails=1))
+        mgr.register_model(_fc_spec(tmp_path, "gold", slo="interactive",
+                                    tenant="gold"))
+        mgr.register_model(_fc_spec(tmp_path, "bulk", slo="batch",
+                                    tenant="bulk"))
+        mgr.record_demand("gold", 5)
+        mgr.record_demand("bulk", 1)
+        mgr.replan()
+        assert mgr.placement() == {"gold": 0, "bulk": 1}
+        gen0 = mgr.plan_generation()
+
+        # host 0 dies: gold's replica is killed, the probe notices
+        mgr.kill_replica("gold")
+        hp.mark_down(0)  # explicit transition -> ladder fires inline
+
+        assert mgr.plan_generation() > gen0
+        assert mgr.placement() == {"gold": 1}  # rung 1: warm re-fault
+        assert mgr.server_for("gold").cold_bucket_runs() == 0
+        assert mgr.server_for("bulk") is None  # rung 3: paged out
+        b = door.quotas.brownout()
+        assert b is not None and b[0] == 1  # rung 2: floor below batch
+
+        # interactive traffic keeps its SLO; batch is shed with an ETA
+        out = door.predict("gold", tenant="gold",
+                           data=np.zeros(IN_DIM, np.float32))
+        assert np.asarray(out[0]).shape == (2,)
+        with pytest.raises(BrownoutError) as ei:
+            door.predict("bulk", tenant="bulk", slo="batch",
+                         data=np.zeros(IN_DIM, np.float32))
+        assert ei.value.retry_after > 0
+        assert door.quotas.snapshot()["bulk"]["browned"] == 1
+
+        # the host comes back: replan restores bulk, brownout lifts
+        hp.mark_up(0)
+        assert door.quotas.brownout() is None
+        assert mgr.server_for("bulk") is not None
+        out = door.predict("bulk", tenant="bulk", slo="batch",
+                           data=np.zeros(IN_DIM, np.float32))
+        assert np.asarray(out[0]).shape == (2,)
+
+        reaps = telemetry.events_of("platform_replica_reap")
+        assert [(e["model"], e["domain"]) for e in reaps] == [("gold", 0)]
+        b_evs = telemetry.events_of("platform_brownout")
+        assert [e["engaged"] for e in b_evs] == [True, False]
+        gens = [e["gen"] for e in telemetry.events_of(
+            "platform_plan_actuate")]
+        assert gens == sorted(gens)  # monotonic plan generations
+    reg.close()
+
+
+# -- satellite 4: concurrent page-out vs in-flight traffic -------------------
+
+def test_concurrent_page_out_vs_inflight_infer(tmp_path):
+    """Predict storms race a graceful page-out: every request either
+    completes or fails with the retryable family — never a hang, never a
+    partial-state crash — and the model demand-pages back in warm."""
+    pool = DevicePool(num_devices=1, bytes_per_device=1 << 20)
+    with ModelManager(pool) as mgr, FrontDoor(mgr) as door:
+        mgr.register_model(_fc_spec(tmp_path, "race"))
+        door.predict("race", data=np.zeros(IN_DIM, np.float32))
+        stop = threading.Event()
+        oks, fails, bad = [0], [0], []
+
+        def storm():
+            while not stop.is_set():
+                try:
+                    door.predict("race",
+                                 data=np.zeros(IN_DIM, np.float32))
+                    oks[0] += 1
+                except (ServerClosedError, NoReplicaAvailableError,
+                        FaultInProgressError):
+                    fails[0] += 1
+                except Exception as exc:  # pragma: no cover
+                    bad.append(exc)
+                    return
+
+        threads = [threading.Thread(target=storm) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(3):
+                time.sleep(0.05)
+                mgr.page_out("race", graceful=True)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads)
+        assert not bad, bad
+        assert oks[0] > 0
+        # post-race state is consistent: one more request re-faults warm
+        out = door.predict("race", data=np.zeros(IN_DIM, np.float32))
+        assert np.asarray(out[0]).shape == (2,)
+        assert mgr.server_for("race").cold_bucket_runs() == 0
+
+
+def test_concurrent_page_out_vs_inflight_generate(tmp_path):
+    """A live generate stream races a graceful page-out of its only
+    replica: the stream either finishes or surfaces the retryable
+    family (with a second replica the router resumes it — that path is
+    the chaos host-loss scenario's job)."""
+    V, S = 16, 16
+    net = mx.models.get_transformer_lm(vocab_size=V, num_layers=1,
+                                       num_heads=2, hidden=16, seq_len=S)
+    arg_shapes, _, _ = net.infer_shape(data=(1, S), softmax_label=(1, S))
+    rng = np.random.RandomState(2)
+    params = {n: mx.nd.array(rng.randn(*s).astype(np.float32) * 0.05)
+              for n, s in zip(net.list_arguments(), arg_shapes)
+              if n not in ("data", "softmax_label")}
+    prefix = str(tmp_path / "lm")
+    mx.model.save_checkpoint(prefix, 1, net, params, {})
+    gspec = dict(vocab_size=V, num_layers=1, num_heads=2, hidden=16,
+                 max_seq_len=S, lane_buckets=(1,), page_size=4,
+                 num_pages=16, prefill_len_buckets=(8,),
+                 prefill_batch_buckets=(1,))
+    pool = DevicePool(num_devices=1, bytes_per_device=1 << 20)
+    with ModelManager(pool) as mgr, FrontDoor(mgr) as door:
+        mgr.register_model(ModelSpec(
+            "lm", prefix, 1, {"data": (1, S), "softmax_label": (1, S)},
+            slo="generate", generator_spec=gspec,
+            server_kwargs={"buckets": (1,)}))
+        # a full, unraced stream works
+        assert len(list(door.generate("lm", [3, 1, 4], 4))) == 4
+
+        done = threading.Event()
+        bad = []
+
+        def streamer():
+            try:
+                for _ in range(20):
+                    list(door.generate("lm", [3, 1, 4], 8))
+            except (ServerClosedError, NoReplicaAvailableError,
+                    FaultInProgressError):
+                pass
+            except Exception as exc:  # pragma: no cover
+                bad.append(exc)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=streamer)
+        t.start()
+        time.sleep(0.05)
+        mgr.page_out("lm", graceful=True)
+        assert done.wait(timeout=60), "stream hung across page-out"
+        t.join(timeout=5)
+        assert not bad, bad
+        # and the model comes back warm
+        assert len(list(door.generate("lm", [3, 1, 4], 4))) == 4
+
+
+# -- satellite 3: router probe debounce knob ---------------------------------
+
+def test_router_probe_fails_env(monkeypatch):
+    from mxnet_tpu.serving.router import _RemoteReplica
+
+    reg = ReplicaRegistry(ttl_ms=60_000)
+    r = Router(registry=reg, registry_sync_ms=10_000)
+    try:
+        rep = _RemoteReplica("a", "http://127.0.0.1:9", r)
+        assert rep._probe_k == 3  # MXNET_SERVING_PROBE_FAILURES default
+        monkeypatch.setenv("MXNET_ROUTER_PROBE_FAILS", "1")
+        assert _RemoteReplica("b", "http://127.0.0.1:9", r)._probe_k == 1
+        monkeypatch.setenv("MXNET_ROUTER_PROBE_FAILS", "0")
+        monkeypatch.setenv("MXNET_SERVING_PROBE_FAILURES", "5")
+        assert _RemoteReplica("c", "http://127.0.0.1:9", r)._probe_k == 5
+    finally:
+        r.close()
+        reg.close()

@@ -48,10 +48,24 @@ with eviction enabled, hard-kills one mid-run under a seeded FaultPlan
 server to evict it, then joins a fresh rank mid-run and verifies every
 survivor lands on the churn-invariant final weight (see
 tests/elastic_churn_worker.py).
+
+``host-loss`` runs the multi-model platform on 2 hosts x 2 devices and
+kills every replica on one host mid-stream and mid-fault-in (heartbeats
+stop without deregistration); the health plane must flip the failure
+domain dead and the degradation ladder must re-fault the evicted
+interactive model warm, brown out the batch class with honest 503s, and
+fail generate streams over mid-token with bit-identical transcripts.
+
+Scenario sweeps print one machine-readable summary JSON object on
+stdout — ``{"scenario", "seeds", "ok", "failing_seeds", "runs": [{seed,
+ok, per-tenant failure counts, ...}]}`` — mirror it to a file with
+``--summary-json PATH``; the exit code stays nonzero on any invariant
+breach.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -1192,13 +1206,382 @@ def run_tenant_storm(seed, timeout=120.0, good_threads=2):
     return ok
 
 
+def run_host_loss(seed, timeout=120.0, stream_threads=3):
+    """Failure-domain survival probe, in-process: a FrontDoor platform
+    serves three tenants on 2 hosts x 2 devices — 'chat' (generate SLO,
+    2 replicas spread across hosts), 'gold' (interactive), 'bulk'
+    (batch) — and every replica on one host is killed mid-stream and
+    mid-fault-in (heartbeats stop WITHOUT deregistration: only the
+    health plane's probe can discover the loss).  The degradation
+    ladder must then (1) reap the corpses and re-fault the evicted
+    interactive model WARM onto the survivors, (2) brown out the batch
+    class (503 + Retry-After) while capacity is short, (3) keep every
+    live chat stream bit-identical to the single-engine reference via
+    mid-stream failover.  Passes when chat saw zero failures and zero
+    transcript mismatches with >= 1 mid-stream resume, gold saw zero
+    hard failures (its fault-in-window 503s carried a positive
+    Retry-After) and recovered with zero cold-bucket runs, bulk was
+    shed by the brownout, the plan generation advanced, every surviving
+    placement sits on an alive device, and resident_bytes drops to
+    zero at close.  Returns a summary dict (``ok`` + per-tenant failure
+    counts) that main() folds into the machine-readable summary JSON."""
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import faults, telemetry
+    from mxnet_tpu.platform import (BrownoutError, DevicePool,
+                                    FaultInProgressError, FrontDoor,
+                                    HealthPlane, ModelManager, ModelSpec)
+    from mxnet_tpu.serving.batcher import ServerClosedError
+    from mxnet_tpu.serving.registry import ReplicaRegistry
+    from mxnet_tpu.serving.router import NoReplicaAvailableError
+
+    tmp = tempfile.mkdtemp(prefix="chaos-hostloss-")
+    envs = {"MXNET_COMPILE_CACHE_DIR": os.path.join(tmp, "cache"),
+            "MXNET_PLATFORM_MIN_RESIDENT_S": "0",
+            "MXNET_PLATFORM_DRAIN_MS": "2000",
+            "MXNET_SERVING_REGISTRY_HEARTBEAT_MS": "25"}
+    prev = {k: os.environ.get(k) for k in envs}
+    os.environ.update(envs)
+    telemetry.enable()
+
+    V, S, in_dim = 32, 16, 4
+    rng = np.random.RandomState(seed)
+    # prefill buckets must cover prompt + emitted: a mid-stream resume
+    # re-prefills the whole transcript so far
+    gspec = dict(vocab_size=V, num_layers=1, num_heads=2, hidden=16,
+                 max_seq_len=S, lane_buckets=(1, 2), page_size=4,
+                 num_pages=16, prefill_len_buckets=(8, 16))
+    lm = mx.models.get_transformer_lm(vocab_size=V, num_layers=1,
+                                      num_heads=2, hidden=16, seq_len=S)
+    arg_shapes, _, _ = lm.infer_shape(data=(1, S), softmax_label=(1, S))
+    lm_params = {
+        name: mx.nd.array(rng.randn(*shp).astype(np.float32) * 0.05)
+        for name, shp in zip(lm.list_arguments(), arg_shapes)
+        if name not in ("data", "softmax_label")}
+    lm_prefix = os.path.join(tmp, "chat")
+    mx.model.save_checkpoint(lm_prefix, 1, lm, lm_params, {})
+    fc_prefix = {}
+    for name in ("gold", "bulk"):
+        net = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                    num_hidden=2, name="fc")
+        params = {"fc_weight": mx.nd.array(
+                      rng.randn(2, in_dim).astype(np.float32)),
+                  "fc_bias": mx.nd.array(rng.randn(2).astype(np.float32))}
+        fc_prefix[name] = os.path.join(tmp, name)
+        mx.model.save_checkpoint(fc_prefix[name], 1, net, params, {})
+
+    # greedy decode is deterministic: one reference engine's transcript
+    # is THE correct answer for every (prompt, max_new) the storm sends
+    ref_engine = mx.generation.DecodeEngine(lm_params, **gspec)
+    prompts = []
+    for i in range(6):
+        plen = 2 + int(rng.randint(0, 6))
+        prompts.append(([int(t) for t in rng.randint(0, V, size=plen)],
+                        4 + int(rng.randint(0, 6))))
+    reference = {i: ref_engine.generate(p, n)
+                 for i, (p, n) in enumerate(prompts)}
+    ref_engine.stop()
+
+    specs = [
+        ModelSpec("chat", lm_prefix, 1,
+                  {"data": (1, S), "softmax_label": (1, S)},
+                  tenant="chat", slo="generate", replicas=2,
+                  param_bytes=1000, generator_spec=dict(gspec),
+                  server_kwargs={"buckets": (1,), "max_wait_us": 1000}),
+        ModelSpec("gold", fc_prefix["gold"], 1, {"data": (1, in_dim)},
+                  tenant="gold", slo="interactive", param_bytes=7554,
+                  server_kwargs={"buckets": (1,), "max_wait_us": 1000}),
+        ModelSpec("bulk", fc_prefix["bulk"], 1, {"data": (1, in_dim)},
+                  tenant="bulk", slo="batch", param_bytes=7554,
+                  server_kwargs={"buckets": (1,), "max_wait_us": 1000}),
+    ]
+    totals = {s.name: s.footprint()["total"] for s in specs}
+    if len(set(totals.values())) != 1:
+        print("chaos_run: footprint mismatch %r" % (totals,),
+              file=sys.stderr, flush=True)
+        return {"ok": False, "notes": ["footprint mismatch"]}
+    # one model-replica per device, exactly — and pin the declared
+    # footprints: live cost-analysis refinement would re-scale the toy
+    # byte budget mid-run
+    orig_observe = ModelSpec.observe_exec_bytes
+    ModelSpec.observe_exec_bytes = lambda self, nbytes: None
+
+    pool = DevicePool(num_devices=4,
+                      bytes_per_device=totals["chat"] + 1,
+                      devices_per_host=2)
+    reg = ReplicaRegistry(ttl_ms=400)
+    mgr = ModelManager(pool, registry=reg)
+    hp = mgr.attach_health(HealthPlane(pool, registry=reg, probe_fails=2))
+    for s in specs:
+        mgr.register_model(s)
+    door = FrontDoor(mgr)
+    # delayed fault-ins hold every fault-in window open ~0.4s so the
+    # kill provably lands mid-fault-in and the door's 503s are
+    # observable from the gold tenant's thread
+    faults.install(faults.FaultPlan("platform.fault_in:delay=1@0.4",
+                                    seed))
+
+    counts = {"chat_ok": 0, "chat_fail": 0, "mismatch": 0,
+              "gold_ok": 0, "gold_fail": 0, "gold_503": 0,
+              "bulk_ok": 0, "bulk_shed": 0, "bulk_fail": 0}
+    errs = []
+    lock = threading.Lock()
+    stop_evt = threading.Event()
+    deadline = time.monotonic() + timeout
+    x = np.zeros(in_dim, np.float32)
+
+    def chat_load(tid):
+        i = tid
+        while not stop_evt.is_set() and time.monotonic() < deadline:
+            pi = i % len(prompts)
+            prompt, max_new = prompts[pi]
+            try:
+                toks = list(door.generate("chat", prompt, max_new,
+                                          tenant="chat",
+                                          deadline_ms=10_000))
+                with lock:
+                    if toks != reference[pi]:
+                        counts["mismatch"] += 1
+                    else:
+                        counts["chat_ok"] += 1
+            except (ServerClosedError, NoReplicaAvailableError,
+                    FaultInProgressError):
+                time.sleep(0.02)  # mid-reap race window: retryable
+            except Exception as exc:
+                with lock:
+                    counts["chat_fail"] += 1
+                    errs.append("chat: %r" % (exc,))
+                time.sleep(0.05)
+            i += stream_threads
+
+    def gold_load():
+        while not stop_evt.is_set() and time.monotonic() < deadline:
+            try:
+                door.predict("gold", tenant="gold", deadline_ms=5000,
+                             data=x)
+                with lock:
+                    counts["gold_ok"] += 1
+            except (FaultInProgressError, BrownoutError) as exc:
+                with lock:
+                    counts["gold_503"] += 1
+                    if not exc.retry_after > 0:
+                        counts["gold_fail"] += 1
+                        errs.append("gold: 503 with retry_after=%r"
+                                    % (exc.retry_after,))
+                time.sleep(min(exc.retry_after, 0.2))
+            except (ServerClosedError, NoReplicaAvailableError):
+                time.sleep(0.02)  # mid-reap race window: retryable
+            except Exception as exc:
+                with lock:
+                    counts["gold_fail"] += 1
+                    errs.append("gold: %r" % (exc,))
+            time.sleep(0.01)
+
+    def bulk_load():
+        while not stop_evt.is_set() and time.monotonic() < deadline:
+            try:
+                door.predict("bulk", tenant="bulk", slo="batch",
+                             deadline_ms=5000, data=x)
+                with lock:
+                    counts["bulk_ok"] += 1
+            except BrownoutError as exc:
+                with lock:
+                    counts["bulk_shed"] += 1
+                    if not exc.retry_after > 0:
+                        counts["bulk_fail"] += 1
+                        errs.append("bulk: 503 with retry_after=%r"
+                                    % (exc.retry_after,))
+                time.sleep(0.05)
+            except (FaultInProgressError, ServerClosedError,
+                    NoReplicaAvailableError):
+                time.sleep(0.02)
+            except Exception as exc:
+                with lock:
+                    counts["bulk_fail"] += 1
+                    errs.append("bulk: %r" % (exc,))
+            time.sleep(0.02)
+
+    ok = True
+    notes = []
+
+    def fail(msg):
+        nonlocal ok
+        ok = False
+        notes.append(msg)
+        print("chaos_run: host-loss: %s" % msg, file=sys.stderr,
+              flush=True)
+
+    gen1 = resumes = gold_cold = 0
+    victim_dom = -1
+    # two gold clients: during recovery one gets "queued" (blocks inside
+    # the ladder-raced fault-in), the other observes the open window and
+    # must get the honest 503 + Retry-After
+    threads = ([threading.Thread(target=chat_load, args=(t,), daemon=True)
+                for t in range(stream_threads)]
+               + [threading.Thread(target=gold_load, daemon=True),
+                  threading.Thread(target=gold_load, daemon=True),
+                  threading.Thread(target=bulk_load, daemon=True)])
+    try:
+        for name, d in (("chat", 9.0), ("gold", 5.0), ("bulk", 1.0)):
+            mgr.record_demand(name, d)
+        mgr.replan()
+        placed = mgr.replica_placement()
+        doms = {pool.domain_of(d) for d in placed.get("chat", {}).values()}
+        if doms != {0, 1}:
+            fail("chat replicas not spread across hosts: %r" % (placed,))
+        gen0 = mgr.plan_generation()
+        # gold's host is the victim: it holds gold plus one chat replica
+        victim_dom = pool.domain_of(placed["gold"][0])
+        victims = [(n, i) for n, reps in placed.items()
+                   for i, d in reps.items()
+                   if pool.domain_of(d) == victim_dom]
+        kill_after = 2 + seed % 3  # chat streams completed pre-kill
+        print("chaos_run: host-loss seed %d: host %d dies (%s) after %d "
+              "streams" % (seed, victim_dom,
+                           ",".join("%s/r%d" % v for v in victims),
+                           kill_after),
+              file=sys.stderr, flush=True)
+        for t in threads:
+            t.start()
+        while time.monotonic() < deadline and counts["chat_ok"] < kill_after:
+            time.sleep(0.02)
+        # "mid-stream" must be literal: hold the kill until the victim
+        # chat replica has a generate stream actually in flight
+        chat_vic = next(i for n, i in victims if n == "chat")
+        vic_srv = mgr._servers["chat"][chat_vic]
+        while time.monotonic() < deadline and \
+                vic_srv._generator.active_lanes() < 1:
+            time.sleep(0.002)
+        pre_kill = dict(counts)
+        for n, i in victims:
+            mgr.kill_replica(n, replica=i)
+        # only the probe can discover the loss: corpses TTL out of the
+        # registry, K consecutive misses flip the domain, and the
+        # ladder runs inline right here
+        while time.monotonic() < deadline and \
+                victim_dom not in hp.dead_domains():
+            hp.probe()
+            time.sleep(0.05)
+        if victim_dom not in hp.dead_domains():
+            fail("health plane never declared host %d dead" % victim_dom)
+        while time.monotonic() < deadline and \
+                mgr.server_for("gold") is None:
+            time.sleep(0.05)
+        srv = mgr.server_for("gold")
+        if srv is None:
+            fail("gold never re-faulted onto a survivor")
+        else:
+            gold_cold = srv.cold_bucket_runs()
+            if gold_cold != 0:
+                fail("gold re-fault was cold (cold_bucket_runs=%d)"
+                     % gold_cold)
+        # run the degraded storm until every class shows its verdict
+        settle = time.monotonic() + 8.0
+        while time.monotonic() < min(deadline, settle) and not (
+                counts["chat_ok"] > pre_kill["chat_ok"] + stream_threads
+                and counts["gold_ok"] > pre_kill["gold_ok"]
+                and counts["bulk_shed"] > 0):
+            time.sleep(0.05)
+        stop_evt.set()
+        for t in threads:
+            t.join(timeout=30)
+        if any(t.is_alive() for t in threads):
+            fail("load threads failed to stop")
+
+        gen1 = mgr.plan_generation()
+        resumes = door.router_for("chat").metrics.snapshot()[
+            "stream_resumes"]
+        if not gen1 > gen0:
+            fail("plan generation did not advance (%d -> %d)"
+                 % (gen0, gen1))
+        if counts["chat_fail"] or counts["mismatch"]:
+            fail("chat streams broke: %d failures, %d mismatches"
+                 % (counts["chat_fail"], counts["mismatch"]))
+        if counts["chat_ok"] <= pre_kill["chat_ok"] + stream_threads:
+            fail("chat barely served post-kill (%d -> %d)"
+                 % (pre_kill["chat_ok"], counts["chat_ok"]))
+        if resumes < 1:
+            fail("no mid-stream resume was exercised")
+        if counts["gold_fail"]:
+            fail("gold saw %d hard failures" % counts["gold_fail"])
+        if counts["gold_ok"] <= pre_kill["gold_ok"]:
+            fail("gold never served after the ladder ran")
+        if counts["gold_503"] < 1:
+            fail("gold never saw the fault-in-window 503")
+        if counts["bulk_fail"]:
+            fail("bulk saw %d hard failures" % counts["bulk_fail"])
+        if counts["bulk_shed"] < 1:
+            fail("bulk was never browned out")
+        b = door.quotas.brownout()
+        if b is None:
+            fail("no brownout active after capacity loss")
+        if mgr.server_for("bulk") is not None:
+            fail("bulk still resident on degraded capacity")
+        bad = [(n, d) for n, reps in mgr.replica_placement().items()
+               for d in reps.values()
+               if pool.domain_of(d) == victim_dom]
+        if bad:
+            fail("placements still on the dead host: %r" % (bad,))
+    finally:
+        stop_evt.set()
+        faults.uninstall()
+        ModelSpec.observe_exec_bytes = orig_observe
+        try:
+            door.close()
+            mgr.close()
+        finally:
+            hp.close()
+            reg.close()
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    if mgr.resident_bytes() != 0:
+        fail("resident_bytes=%d after close" % mgr.resident_bytes())
+    for e in errs[:5]:
+        print("chaos_run: host-loss error: %s" % e, file=sys.stderr,
+              flush=True)
+    if ok:
+        print("chaos_run: host-loss ok: %d streams (0 failed, 0 "
+              "mismatched, %d resumes), gold served %d with %d honest "
+              "503s and a warm re-fault, bulk shed %d, plan gen %d"
+              % (counts["chat_ok"], resumes, counts["gold_ok"],
+                 counts["gold_503"], counts["bulk_shed"], gen1),
+              file=sys.stderr, flush=True)
+        shutil.rmtree(tmp, ignore_errors=True)
+    else:
+        print("chaos_run: artifacts kept at %s" % tmp,
+              file=sys.stderr, flush=True)
+    return {"ok": ok, "victim_domain": victim_dom,
+            "streams": counts["chat_ok"], "stream_resumes": resumes,
+            "transcript_mismatches": counts["mismatch"],
+            "plan_generation": gen1,
+            "tenant_failures": {"chat": counts["chat_fail"],
+                                "gold": counts["gold_fail"],
+                                "bulk": counts["bulk_fail"]},
+            "gold_503s": counts["gold_503"],
+            "bulk_shed": counts["bulk_shed"], "notes": notes}
+
+
 _SCENARIOS = {"membership-churn": run_membership_churn,
               "serving-failover": run_serving_failover,
               "flash-crowd": run_flash_crowd,
               "decode-storm": run_decode_storm,
               "sparse-replay": run_sparse_replay,
               "sdc-rollback": run_sdc_rollback,
-              "tenant-storm": run_tenant_storm}
+              "tenant-storm": run_tenant_storm,
+              "host-loss": run_host_loss}
 
 
 def main():
@@ -1218,6 +1601,9 @@ def main():
                         help="sweep seeds A..B-1, report pass/fail each")
     parser.add_argument("--timeout", type=float, default=None,
                         help="per-run timeout in seconds")
+    parser.add_argument("--summary-json", default=None, metavar="PATH",
+                        help="also write the scenario summary JSON to "
+                             "this file (it always goes to stdout)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     command = args.command
@@ -1238,13 +1624,38 @@ def main():
                          "own rank-gated spec; drop --spec and the command")
         scenario = _SCENARIOS[args.scenario]
         failures = []
+        runs = []
         for seed in seeds:
-            ok = scenario(seed, timeout=args.timeout or 120.0)
+            try:
+                res = scenario(seed, timeout=args.timeout or 120.0)
+            except Exception as exc:
+                print("chaos_run: scenario %s seed %d CRASHED: %r"
+                      % (args.scenario, seed, exc),
+                      file=sys.stderr, flush=True)
+                res = {"ok": False, "error": repr(exc)}
+            # scenarios return a bare bool or a summary dict ({"ok":
+            # bool, ...extra fields}) folded into the summary JSON
+            if isinstance(res, dict):
+                ok = bool(res.get("ok"))
+                extra = {k: v for k, v in res.items() if k != "ok"}
+            else:
+                ok, extra = bool(res), {}
+            runs.append(dict({"seed": seed, "ok": ok}, **extra))
             print("chaos_run: scenario %s seed %d -> %s"
                   % (args.scenario, seed, "ok" if ok else "FAILED"),
                   file=sys.stderr, flush=True)
             if not ok:
                 failures.append(seed)
+        # machine-readable verdict: one JSON object on stdout (all the
+        # human chatter goes to stderr), optionally mirrored to a file
+        summary = {"scenario": args.scenario, "seeds": list(seeds),
+                   "ok": not failures, "failing_seeds": failures,
+                   "runs": runs}
+        line = json.dumps(summary, sort_keys=True, default=str)
+        print(line, flush=True)
+        if args.summary_json:
+            with open(args.summary_json, "w") as fh:
+                fh.write(line + "\n")
         if failures:
             print("chaos_run: failing seeds: %s  (replay one with --seed N)"
                   % failures, file=sys.stderr, flush=True)
