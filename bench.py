@@ -548,18 +548,29 @@ def _generate_fields(timeout=600):
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "tools", "bench_generate.py")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    try:
-        proc = subprocess.run([sys.executable, script],
-                              capture_output=True, text=True,
-                              timeout=timeout, env=env)
-    except (subprocess.TimeoutExpired, OSError) as e:
-        return {"generate_error": str(e)[:300]}
-    for line in reversed(proc.stdout.strip().splitlines()):
+
+    def _mode(extra_args):
         try:
-            rec = json.loads(line)
-        except ValueError:
-            continue
-        return {
+            proc = subprocess.run([sys.executable, script] + extra_args,
+                                  capture_output=True, text=True,
+                                  timeout=timeout, env=env)
+        except (subprocess.TimeoutExpired, OSError) as e:
+            return None, str(e)[:300]
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                return json.loads(line), None
+            except ValueError:
+                continue
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return None, "rc=%d %s" % (proc.returncode,
+                                   "; ".join(tail[-2:])[:300])
+
+    fields = {}
+    rec, err = _mode([])
+    if rec is None:
+        fields["generate_error"] = err
+    else:
+        fields.update({
             "generate_tokens_per_sec": rec.get("value"),
             "generate_naive_tokens_per_sec":
                 rec.get("naive_tokens_per_sec"),
@@ -573,10 +584,39 @@ def _generate_fields(timeout=600):
             "generate_live_token_page_bound":
                 rec.get("live_token_page_bound"),
             "generate_cold_decode_runs": rec.get("cold_decode_runs"),
-        }
-    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-    return {"generate_error": "rc=%d %s" % (proc.returncode,
-                                            "; ".join(tail[-2:])[:300])}
+        })
+    # prefix-cache phase: TTFT cached vs uncached on a shared-prefix storm
+    rec, err = _mode(["--prefix-reuse"])
+    if rec is None:
+        fields["generate_prefix_error"] = err
+    else:
+        fields.update({
+            "generate_prefix_ttft_reduction": rec.get("value"),
+            "generate_prefix_ttft_ms_p50_cached":
+                rec.get("ttft_ms_p50_cached"),
+            "generate_prefix_ttft_ms_p50_uncached":
+                rec.get("ttft_ms_p50_uncached"),
+            "generate_prefix_outputs_identical":
+                rec.get("outputs_identical"),
+            "generate_prefix_hits": rec.get("prefix_hits"),
+            "generate_prefix_prefill_tokens_cached":
+                rec.get("prefill_tokens_cached"),
+        })
+    # speculative phase: draft+verify tokens/s vs the plain engine
+    rec, err = _mode(["--draft"])
+    if rec is None:
+        fields["generate_draft_error"] = err
+    else:
+        fields.update({
+            "generate_draft_speedup": rec.get("value"),
+            "generate_draft_tokens_per_sec":
+                rec.get("tokens_per_sec_draft"),
+            "generate_draft_acceptance": rec.get("acceptance"),
+            "generate_draft_k": rec.get("draft_k"),
+            "generate_draft_outputs_identical":
+                rec.get("outputs_identical"),
+        })
+    return fields
 
 
 def _platform_fields(timeout=300):

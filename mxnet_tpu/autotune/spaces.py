@@ -88,6 +88,17 @@ def decode_engine(max_lanes: int, max_seq_len: int) -> List[dict]:
     return out
 
 
+DRAFT_K_CHOICES = (1, 2, 3, 4, 6, 8)
+
+
+def draft_k() -> List[dict]:
+    """Candidate speculative draft lengths (tokens proposed per
+    iteration).  The engine scores them analytically — expected cost
+    per accepted token under the configured acceptance hint — so the
+    grid stays small and the tune is instant."""
+    return [{"k": k} for k in DRAFT_K_CHOICES]
+
+
 def serving_buckets(max_batch: int) -> List[dict]:
     """Candidate serving micro-batch bucket sets: pow2 ladder, single
     max bucket, halves ladder, and (small max) the dense ladder."""

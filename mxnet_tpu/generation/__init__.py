@@ -5,8 +5,12 @@ memory scales with live tokens instead of max_len x batch.
 ``engine``: :class:`DecodeEngine`, iteration-level continuous batching
 over fixed-shape per-lane-bucket decode executables (admit/retire every
 step, zero post-warmup recompiles, streaming :class:`GenStream`
-handles).  Serving integration (``generate`` SLO class, ``POST
-/generate`` token streaming) lives in ``mxnet_tpu.serving``.
+handles).  Token-path optimizations: cross-request prefix caching
+(content-hashed copy-on-write KV pages, ``MXNET_GEN_PREFIX_CACHE_PAGES``)
+and speculative decoding (draft model + fused verify pass, bit-identical
+greedy acceptance, autotuned draft length).  Serving integration
+(``generate`` SLO class, ``POST /generate`` token streaming) lives in
+``mxnet_tpu.serving``.
 """
 from .engine import DecodeEngine, GenStream
 from .kv_pool import KVPoolExhaustedError, PagedKVPool

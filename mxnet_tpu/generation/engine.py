@@ -9,6 +9,33 @@ admitted into free lanes the moment prefill finishes, finished ones
 retire immediately — so short requests never wait for long ones and
 the decode executable stays saturated (Orca / vLLM, PAPERS.md).
 
+Two token-path optimizations ride on top of the paged pool:
+
+* **Cross-request prefix caching** (``prefix_cache_pages`` /
+  ``MXNET_GEN_PREFIX_CACHE_PAGES``): admission resolves the prompt
+  against :class:`~.kv_pool.PagedKVPool`'s content-hash prefix index.
+  A fully-cached prompt skips prefill entirely — the sequence enters
+  decode with ``next_pos`` pointing at its LAST prompt position, so
+  TTFT collapses to ONE engine iteration.  Pages are refcounted and
+  copy-on-write: before any write into a potentially shared page the
+  engine calls ``ensure_writable``.  Every complete page a sequence
+  materializes is re-published (``register_prefix``), which also makes
+  preemption cheap: the re-admitted sequence finds its own pages in
+  the index instead of re-prefilling prompt+generated from scratch.
+
+* **Speculative decoding** (``draft=``): a small draft model proposes
+  K tokens per iteration (its own paged pool + decode executables),
+  then ONE windowed target pass — the same teacher-forcing graph as
+  prefix catch-up (``models.transformer.get_transformer_lm_catchup``),
+  since every feed token is known before the call — scores all K+1
+  slots in a single causal forward.  Greedy acceptance keeps every
+  token whose draft matched the target argmax, so transcripts match
+  non-speculative greedy (asserted per-K by the spec-parity tests).  A
+  per-stream acceptance-rate EWMA feeds the ``draft_k`` autotune site
+  (objective: accepted tokens per target FLOP), and the winning K is
+  resolved at construction so it travels inside ``spec()`` / AOT
+  bundles — a restored replica speculates with zero re-tuning.
+
 XLA discipline: every XLA-visible shape here is static.
 
 * Prefill runs through one :class:`~mxnet_tpu.serving.batcher.
@@ -18,17 +45,18 @@ XLA discipline: every XLA-visible shape here is static.
   get_transformer_lm_decode``): ``lanes`` sequences advance one token
   through per-lane page tables into a shared paged KV pool
   (:mod:`.kv_pool`), compiled ONCE per lane-count bucket and primed
-  through the PR 10 compile cache (entry kind ``gen-step`` /
-  ``gen-prefill``), so AOT bundles restore a generate-ready replica
-  with zero cold compiles.
+  through the PR 10 compile cache (entry kinds ``gen-step`` /
+  ``gen-prefill`` / ``gen-verify`` / ``gen-draft-step`` /
+  ``gen-draft-prefill``), so AOT bundles restore a generate-ready
+  replica with zero cold compiles.
 
 Backpressure: admission is a bounded pending queue (reject =
 :class:`~mxnet_tpu.serving.batcher.QueueFullError`, the HTTP 429/503
 contract) plus KV-pool capacity; a mid-decode pool exhaustion preempts
-the youngest lane (its pages are freed, the sequence re-queues for
-re-prefill of prompt+generated — greedy decode is deterministic, so
-the stream continues seamlessly), which bounds memory without ever
-deadlocking.
+the youngest lane (its pages are freed — though complete ones stay in
+the prefix index — and the sequence re-queues for re-admission of
+prompt+generated; greedy decode is deterministic, so the stream
+continues seamlessly), which bounds memory without ever deadlocking.
 """
 from __future__ import annotations
 
@@ -88,6 +116,41 @@ def _autotune_engine_config(num_layers, num_heads, head_dim, max_seq_len,
         candidates=autotune.spaces.decode_engine(max_lanes, max_seq_len),
         score_fn=score, default=None)
 
+
+def _autotune_draft_k(num_layers, hidden, draft_layers, draft_hidden,
+                      acceptance):
+    """Tuned {k: draft length} for a (target, draft) geometry pair, or
+    None.  Analytic objective, lower is better: expected cost per
+    accepted token.  One iteration costs ``(k+1)`` target-token-FLOPs
+    for the fused verify pass plus ``rho*k`` for the draft rounds
+    (``rho`` = draft/target per-token FLOP ratio, dominated by
+    ``layers*hidden^2``), and yields ``sum(a^i, i=0..k)`` expected
+    tokens under per-token acceptance probability ``a`` — the standard
+    speculative-decoding geometric progress model."""
+    try:
+        from .. import autotune
+    except Exception:
+        return None
+    if not autotune.enabled():
+        return None
+    acceptance = min(0.99, max(0.0, float(acceptance)))
+    key = {"num_layers": int(num_layers), "hidden": int(hidden),
+           "draft_layers": int(draft_layers),
+           "draft_hidden": int(draft_hidden),
+           "acceptance": round(acceptance, 1)}
+    rho = ((int(draft_layers) * float(draft_hidden) ** 2)
+           / (int(num_layers) * float(hidden) ** 2))
+
+    def score(cand):
+        k = int(cand["k"])
+        expected = sum(acceptance ** i for i in range(k + 1))
+        return ((k + 1) + rho * k) / expected
+
+    return autotune.get_or_tune(
+        "draft_k", key, candidates=autotune.spaces.draft_k(),
+        score_fn=score, default=None)
+
+
 register_env("MXNET_GEN_PAGE_SIZE", 16, int,
              "KV-pool page size (tokens per page) for DecodeEngine.")
 register_env("MXNET_GEN_NUM_PAGES", 128, int,
@@ -101,6 +164,13 @@ register_env("MXNET_GEN_MAX_NEW_TOKENS", 64, int,
 register_env("MXNET_GEN_PENDING_QUEUE", 256, int,
              "Bounded admission queue for DecodeEngine.submit; beyond it "
              "submissions raise QueueFullError (HTTP 429).")
+register_env("MXNET_GEN_PREFIX_CACHE_PAGES", 0, int,
+             "Max refcount-0 KV pages the cross-request prefix index may "
+             "retain (LRU-evicted); 0 disables prefix caching.")
+register_env("MXNET_GEN_DRAFT_K", 4, int,
+             "Speculative draft length (tokens proposed per iteration) "
+             "when a draft model is configured and no tuned/explicit K "
+             "is available.")
 
 _DONE = object()  # GenStream queue sentinel
 
@@ -111,7 +181,13 @@ class GenStream:
     ``for tok in stream`` yields generated token ids incrementally;
     :meth:`result` blocks for the full list.  ``ttft_ms`` / ``itl_ms``
     expose this request's observed first-token latency and inter-token
-    gaps once available."""
+    gaps once available.  Token-path introspection: ``prefill_tokens``
+    (prompt positions actually prefilled, across re-admissions),
+    ``cached_prefix_tokens`` (positions served from the prefix cache),
+    ``ttft_iters`` (engine iterations before the first token — 0 when
+    prefill itself emitted it, 1 for a fully-cached prompt),
+    ``draft_proposed`` / ``draft_accepted`` / ``accept_rate`` (per-
+    stream speculative acceptance EWMA)."""
 
     def __init__(self, prompt, max_new_tokens):
         self.prompt = list(prompt)
@@ -119,6 +195,12 @@ class GenStream:
         self.tokens: List[int] = []
         self.ttft_ms: Optional[float] = None
         self.itl_ms: List[float] = []
+        self.prefill_tokens = 0
+        self.cached_prefix_tokens = 0
+        self.ttft_iters: Optional[int] = None
+        self.draft_proposed = 0
+        self.draft_accepted = 0
+        self.accept_rate: Optional[float] = None
         self._t0 = time.monotonic()
         self._t_last = None
         self._q: "queue.Queue" = queue.Queue()
@@ -174,10 +256,21 @@ class GenStream:
 
 
 class _Seq:
-    """Engine-internal live-sequence state (one decode lane's occupant)."""
+    """Engine-internal live-sequence state (one decode lane's occupant).
+
+    ``next_pos`` is the feed cursor: the position whose token goes into
+    the NEXT decode/verify slot (every position below it has final K/V
+    materialized in the pool).  Steady state keeps ``next_pos ==
+    len(tokens) - 1``; a cached-prefix admission starts it at the hit
+    length, a partial hit or a re-admitted preemptee walks the known
+    suffix forward one slot per step without emitting.  ``draft_pos``
+    is the same cursor for the draft model's pool; ``limit`` is
+    ``len(prompt) + max_new`` — no position at or beyond it is ever
+    fed, so pool allocations never outgrow the admission-time check."""
 
     __slots__ = ("sid", "stream", "tokens", "gen_count", "max_new",
-                 "deadline", "eos_id", "admitted_at")
+                 "deadline", "eos_id", "admitted_at", "next_pos",
+                 "draft_pos", "iters", "limit")
 
     def __init__(self, sid, stream, deadline, eos_id):
         self.sid = sid
@@ -188,11 +281,16 @@ class _Seq:
         self.deadline = deadline  # absolute monotonic seconds or None
         self.eos_id = eos_id
         self.admitted_at = 0.0
+        self.next_pos = 0
+        self.draft_pos = 0
+        self.iters = 0
+        self.limit = len(stream.prompt) + self.max_new
 
 
 class _GenMetrics:
     """Telemetry collector for one engine: token throughput, TTFT/ITL
-    histograms, admission/retire/preempt counters, lane occupancy."""
+    histograms, admission/retire/preempt counters, lane occupancy, and
+    the speculative-decoding draft economy."""
 
     def __init__(self):
         reg = self._registry = _telemetry.Registry()
@@ -205,11 +303,17 @@ class _GenMetrics:
         self.failed = reg.counter("mxtpu_gen_sequences_failed_total")
         self.steps = reg.counter("mxtpu_gen_decode_steps_total")
         self.cold_steps = reg.counter("mxtpu_gen_decode_cold_steps_total")
+        self.cached_admissions = reg.counter(
+            "mxtpu_gen_prefix_cached_admissions_total")
+        self.draft_proposed = reg.counter("mxtpu_gen_draft_proposed_total")
+        self.draft_accepted = reg.counter("mxtpu_gen_draft_accepted_total")
+        self.spec_fallbacks = reg.counter("mxtpu_gen_spec_fallbacks_total")
         # 0.5ms .. ~16s exponential buckets
         self.ttft = reg.histogram("mxtpu_gen_ttft_ms")
         self.itl = reg.histogram("mxtpu_gen_itl_ms")
         self.g_active = reg.gauge("mxtpu_gen_active_lanes")
         self.g_pending = reg.gauge("mxtpu_gen_pending_requests")
+        self.g_accept = reg.gauge("mxtpu_gen_draft_accept_rate")
         _telemetry.register_collector(self)
 
     def render_prometheus(self):
@@ -237,6 +341,17 @@ class DecodeEngine:
         :class:`BucketedPredictor` per length bucket.
     eos_id : int, optional
         Token id that ends a sequence early.
+    prefix_cache_pages : int, optional
+        Cross-request prefix-cache retention bound (refcount-0 pages
+        the index may keep); default ``MXNET_GEN_PREFIX_CACHE_PAGES``,
+        0 disables caching entirely (legacy semantics).
+    draft : dict, optional
+        Speculative-decoding draft model: ``{"params": path-or-dict,
+        "num_layers": int, "num_heads": int, "hidden": int,
+        "k": int or None, "acceptance_hint": float}``.  ``k`` None
+        consults the ``draft_k`` autotune site, then
+        ``MXNET_GEN_DRAFT_K``; the RESOLVED value is stored back into
+        :meth:`spec` so bundles/replicas rebuild without re-tuning.
     """
 
     def __init__(self, params, vocab_size, num_layers=4, num_heads=8,
@@ -248,10 +363,13 @@ class DecodeEngine:
                  prefill_batch_buckets: Sequence[int] = (1, 2, 4),
                  eos_id: Optional[int] = None,
                  max_pending: Optional[int] = None,
+                 prefix_cache_pages: Optional[int] = None,
+                 draft: Optional[Dict] = None,
                  ctx=None, dtype=np.float32, warmup: bool = True,
                  start: bool = True):
         from .. import ndarray as nd
-        from ..models.transformer import (get_transformer_lm_decode,
+        from ..models.transformer import (get_transformer_lm_catchup,
+                                          get_transformer_lm_decode,
                                           get_transformer_lm_prefill)
         from ..predictor import Predictor
 
@@ -299,6 +417,38 @@ class DecodeEngine:
         self.max_pending = int(env("MXNET_GEN_PENDING_QUEUE", 256, int)
                                if max_pending is None else max_pending)
         self.default_max_new = env("MXNET_GEN_MAX_NEW_TOKENS", 64, int)
+        self.prefix_cache_pages = max(0, int(
+            env("MXNET_GEN_PREFIX_CACHE_PAGES", 0, int)
+            if prefix_cache_pages is None else prefix_cache_pages))
+
+        # -- speculative draft config (resolve K once, here) --------------
+        self._draft: Optional[Dict] = None
+        self._draft_params = None
+        self._verify_width = 1
+        if draft:
+            d = dict(draft)
+            d_layers = int(d.get("num_layers", max(1, self.num_layers // 2)))
+            d_heads = int(d.get("num_heads", self.num_heads))
+            d_hidden = int(d.get("hidden", self.hidden))
+            hint = float(d.get("acceptance_hint", 0.8))
+            k = d.get("k")
+            if k is None:
+                tuned_k = _autotune_draft_k(self.num_layers, self.hidden,
+                                            d_layers, d_hidden, hint)
+                k = (tuned_k.get("k") if tuned_k
+                     else env("MXNET_GEN_DRAFT_K", 4, int))
+            k = max(1, min(int(k), self.max_seq_len - 1))
+            dparams = d.get("params")
+            self._draft = {"params": dparams, "num_layers": d_layers,
+                           "num_heads": d_heads, "hidden": d_hidden,
+                           "k": k, "acceptance_hint": hint}
+            if isinstance(dparams, str):
+                dparams = nd.load(dparams)
+            if dparams is None:
+                raise MXNetError("draft spec needs 'params'")
+            self._draft_params = dict(dparams)
+            self._verify_width = k + 1
+        self._accept_ewma: Optional[float] = None
 
         if isinstance(params, str):
             params = nd.load(params)
@@ -308,7 +458,8 @@ class DecodeEngine:
 
         self.pool = PagedKVPool(self.num_pages, self.page_size,
                                 self.num_layers, self.num_heads,
-                                self.head_dim, dtype=self._dtype)
+                                self.head_dim, dtype=self._dtype,
+                                prefix_cache_pages=self.prefix_cache_pages)
         self.metrics = _GenMetrics()
 
         # prefill: one BucketedPredictor per prompt-length bucket.
@@ -358,6 +509,120 @@ class DecodeEngine:
         for pred in self._decode.values():
             pred._exec._cache_kind = "gen-step"
 
+        # -- speculative rig: draft pool + prefill + decode, target verify
+        self._draft_pool: Optional[PagedKVPool] = None
+        self._draft_prefill: Dict[int, BucketedPredictor] = {}
+        self._draft_decode: Dict[int, "Predictor"] = {}
+        self._verify: Dict[int, "Predictor"] = {}
+        if self._draft is not None:
+            dl = self._draft["num_layers"]
+            dh = self._draft["num_heads"]
+            dhid = self._draft["hidden"]
+            dhd = dhid // dh
+            self._draft_pool = PagedKVPool(self.num_pages, self.page_size,
+                                           dl, dh, dhd, dtype=self._dtype)
+            for L in self.prefill_len_buckets:
+                with NameManager():
+                    symbol = get_transformer_lm_prefill(
+                        self.vocab_size, dl, dh, dhid, seq_len=L,
+                        max_seq_len=self.max_seq_len)
+                bp = BucketedPredictor(symbol, self._draft_params,
+                                       {"data": (L,)},
+                                       self.prefill_batch_buckets,
+                                       ctx=ctx, dtype=dtype)
+                for pred in bp._preds.values():
+                    pred._exec._cache_kind = "gen-draft-prefill"
+                self._draft_prefill[L] = bp
+            with NameManager():
+                dd_symbol = get_transformer_lm_decode(
+                    self.vocab_size, dl, dh, dhid,
+                    max_seq_len=self.max_seq_len, lanes=self.max_lanes,
+                    num_pages=self.num_pages, page_size=self.page_size,
+                    max_pages=self.max_pages)
+            d_pool_shape = (self.num_pages, self.page_size, dh, dhd)
+            d_shapes = {"data": (self.max_lanes,),
+                        "positions": (self.max_lanes,),
+                        "page_table": (self.max_lanes, self.max_pages)}
+            for i in range(dl):
+                d_shapes["layer%d_k_pool" % i] = d_pool_shape
+                d_shapes["layer%d_v_pool" % i] = d_pool_shape
+            d_base = Predictor(dd_symbol, self._draft_params, d_shapes,
+                               ctx=ctx, dtype=dtype)
+            self._draft_decode = {self.max_lanes: d_base}
+            for b in self.lane_buckets[:-1]:
+                self._draft_decode[b] = d_base.reshape(
+                    {"data": (b,), "positions": (b,),
+                     "page_table": (b, self.max_pages)})
+            for pred in self._draft_decode.values():
+                pred._exec._cache_kind = "gen-draft-step"
+            # verification is teacher forcing too — the draft's K
+            # proposals are known before the call — so the verify rig
+            # uses the same windowed single-pass graph as catch-up
+            # rather than chaining K+1 literal decode blocks (whose
+            # dispatch cost eats the speculation win on small models)
+            with NameManager():
+                v_symbol = get_transformer_lm_catchup(
+                    self.vocab_size, self.num_layers, self.num_heads,
+                    self.hidden, max_seq_len=self.max_seq_len,
+                    lanes=self.max_lanes, num_pages=self.num_pages,
+                    page_size=self.page_size, max_pages=self.max_pages,
+                    width=self._verify_width)
+            v_shapes = {"data": (self.max_lanes, self._verify_width),
+                        "positions": (self.max_lanes, self._verify_width),
+                        "page_table": (self.max_lanes, self.max_pages)}
+            for i in range(self.num_layers):
+                v_shapes["layer%d_k_pool" % i] = pool_shape
+                v_shapes["layer%d_v_pool" % i] = pool_shape
+            v_base = Predictor(v_symbol, self._params, v_shapes, ctx=ctx,
+                               dtype=dtype)
+            self._verify = {self.max_lanes: v_base}
+            for b in self.lane_buckets[:-1]:
+                self._verify[b] = v_base.reshape(
+                    {"data": (b, self._verify_width),
+                     "positions": (b, self._verify_width),
+                     "page_table": (b, self.max_pages)})
+            for pred in self._verify.values():
+                pred._exec._cache_kind = "gen-verify"
+
+        # -- prefix-cache catch-up rig: a windowed teacher-forcing
+        # executable that re-walks the KNOWN suffix of a partial prefix
+        # hit (or a re-admitted preemptee) ``catchup_width`` slots per
+        # forward instead of one per decode iteration, so cached
+        # admissions reach their first token in one decode step no
+        # matter where the index's page-granular match stopped
+        self._catchup: Dict[int, "Predictor"] = {}
+        self._catchup_width = 0
+        if self.prefix_cache_pages:
+            # wide enough to swallow a typical page-rounding suffix in
+            # one forward — every extra round pays a full pool
+            # host-roundtrip plus the executable's fixed dispatch cost;
+            # the windowed pass itself is compute-proportional, so a
+            # wider window costs only the pad slots it doesn't use
+            cw = max(2, min(32, self.max_seq_len - 1))
+            self._catchup_width = cw
+            with NameManager():
+                c_symbol = get_transformer_lm_catchup(
+                    self.vocab_size, self.num_layers, self.num_heads,
+                    self.hidden, max_seq_len=self.max_seq_len,
+                    lanes=self.max_lanes, num_pages=self.num_pages,
+                    page_size=self.page_size, max_pages=self.max_pages,
+                    width=cw)
+            c_shapes = {"data": (self.max_lanes, cw),
+                        "positions": (self.max_lanes, cw),
+                        "page_table": (self.max_lanes, self.max_pages)}
+            for i in range(self.num_layers):
+                c_shapes["layer%d_k_pool" % i] = pool_shape
+                c_shapes["layer%d_v_pool" % i] = pool_shape
+            c_base = Predictor(c_symbol, self._params, c_shapes, ctx=ctx,
+                               dtype=dtype)
+            self._catchup = {self.max_lanes: c_base}
+            for b in self.lane_buckets[:-1]:
+                self._catchup[b] = c_base.reshape(
+                    {"data": (b, cw), "positions": (b, cw),
+                     "page_table": (b, self.max_pages)})
+            for pred in self._catchup.values():
+                pred._exec._cache_kind = "gen-catchup"
+
         # recompile-detector bookkeeping: lane buckets warmup compiled,
         # post-warmup steps that hit a novel (never-warmed) bucket
         self.warmed_lane_buckets = set()
@@ -381,8 +646,10 @@ class DecodeEngine:
     # -- construction helpers ---------------------------------------------
     def spec(self) -> Dict:
         """Model/engine geometry needed to rebuild this engine against a
-        new checkpoint (hot-swap, AOT warmup manifests, shadow replicas)."""
-        return {
+        new checkpoint (hot-swap, AOT warmup manifests, shadow replicas).
+        The draft block carries the RESOLVED speculative K — a replica
+        rebuilt from a bundle speculates with zero re-tuning."""
+        out = {
             "vocab_size": self.vocab_size, "num_layers": self.num_layers,
             "num_heads": self.num_heads, "hidden": self.hidden,
             "max_seq_len": self.max_seq_len,
@@ -391,7 +658,11 @@ class DecodeEngine:
             "prefill_len_buckets": list(self.prefill_len_buckets),
             "prefill_batch_buckets": list(self.prefill_batch_buckets),
             "eos_id": self.eos_id, "max_pending": self.max_pending,
+            "prefix_cache_pages": self.prefix_cache_pages,
         }
+        if self._draft is not None:
+            out["draft"] = dict(self._draft)
+        return out
 
     @classmethod
     def from_checkpoint(cls, prefix, epoch, **spec):
@@ -401,40 +672,66 @@ class DecodeEngine:
 
     def warmup(self):
         """Pre-compile every prefill (length x batch) bucket and every
-        decode lane bucket, priming through the compile cache when it is
-        enabled — post-warmup steady state performs ZERO XLA compiles,
-        and an attached AOT bundle makes warmup deserialize-only."""
+        decode/draft/verify lane bucket, priming through the compile
+        cache when it is enabled — post-warmup steady state performs
+        ZERO XLA compiles, and an attached AOT bundle makes warmup
+        deserialize-only."""
         for bp in self._prefill.values():
+            bp.warmup()
+        for bp in self._draft_prefill.values():
             bp.warmup()
         pool_shape = (self.num_pages, self.page_size, self.num_heads,
                       self.head_dim)
         zero_pool = np.zeros(pool_shape, self._dtype)
+        d_zero_pool = None
+        if self._draft is not None:
+            d_zero_pool = np.zeros(
+                (self.num_pages, self.page_size, self._draft["num_heads"],
+                 self._draft["hidden"] // self._draft["num_heads"]),
+                self._dtype)
         for b in self.lane_buckets:
-            pred = self._decode[b]
-            pred.set_input("data", np.zeros((b,), self._dtype))
-            pred.set_input("positions", np.zeros((b,), self._dtype))
-            pred.set_input("page_table",
-                           np.zeros((b, self.max_pages), self._dtype))
-            for i in range(self.num_layers):
-                pred.set_input("layer%d_k_pool" % i, zero_pool)
-                pred.set_input("layer%d_v_pool" % i, zero_pool)
-            pred._exec.forward(is_train=False)
-            for out in pred.get_outputs():
-                out.asnumpy()  # block until compiled + ran
+            rigs = [(self._decode[b], (b,), self.num_layers, zero_pool)]
+            if self._draft is not None:
+                rigs.append((self._draft_decode[b], (b,),
+                             self._draft["num_layers"], d_zero_pool))
+                rigs.append((self._verify[b], (b, self._verify_width),
+                             self.num_layers, zero_pool))
+            if self._catchup:
+                rigs.append((self._catchup[b], (b, self._catchup_width),
+                             self.num_layers, zero_pool))
+            for pred, dshape, n_layers, zpool in rigs:
+                pred.set_input("data", np.zeros(dshape, self._dtype))
+                pred.set_input("positions", np.zeros(dshape, self._dtype))
+                pred.set_input("page_table",
+                               np.zeros((b, self.max_pages), self._dtype))
+                for i in range(n_layers):
+                    pred.set_input("layer%d_k_pool" % i, zpool)
+                    pred.set_input("layer%d_v_pool" % i, zpool)
+                pred._exec.forward(is_train=False)
+                for out in pred.get_outputs():
+                    out.asnumpy()  # block until compiled + ran
             self.warmed_lane_buckets.add(b)
         return self
 
     def compiled_entries(self):
-        """Primed compile-cache wrappers across prefill and decode
-        executors (kinds ``gen-prefill`` / ``gen-step``) — the input to
-        ``checkpoint.save_aot_bundle`` so an autoscaled replica serves
-        its first generate request with zero cold compiles."""
+        """Primed compile-cache wrappers across prefill, decode, draft,
+        verify and catch-up executors (kinds ``gen-prefill`` /
+        ``gen-step`` / ``gen-draft-prefill`` / ``gen-draft-step`` /
+        ``gen-verify`` / ``gen-catchup``) —
+        the input to ``checkpoint.save_aot_bundle`` so an autoscaled
+        replica serves its first generate request with zero cold
+        compiles."""
         from ..compile_cache import CachedFunction
 
         out = []
-        for bp in self._prefill.values():
+        for bp in list(self._prefill.values()) + \
+                list(self._draft_prefill.values()):
             out.extend(bp.compiled_entries())
-        for pred in self._decode.values():
+        preds = (list(self._decode.values())
+                 + list(self._draft_decode.values())
+                 + list(self._verify.values())
+                 + list(self._catchup.values()))
+        for pred in preds:
             for fn in pred._exec._jit_cache.values():
                 if isinstance(fn, CachedFunction):
                     out.append(fn)
@@ -444,8 +741,10 @@ class DecodeEngine:
         """Post-warmup decode steps that hit a never-warmed lane bucket
         plus cold prefill flushes — 0 is the "steady state never
         recompiles" acceptance check."""
-        return self.decode_cold_runs + sum(bp.cold_runs
-                                           for bp in self._prefill.values())
+        return (self.decode_cold_runs
+                + sum(bp.cold_runs for bp in self._prefill.values())
+                + sum(bp.cold_runs
+                      for bp in self._draft_prefill.values()))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -473,6 +772,20 @@ class DecodeEngine:
             # drain deadline expired with work outstanding (or fail-fast
             # stop racing the loop): cancel whatever is left
             self._fail_all_locked(ServerClosedError("engine stopped"))
+        # observed-acceptance feedback: when the measured EWMA drifts a
+        # decile from the configured hint, pre-record the draft_k winner
+        # for the observed rate so the NEXT construction (same geometry,
+        # honest hint) resolves without tuning from the stale prior
+        if self._draft is not None and self._accept_ewma is not None:
+            if abs(self._accept_ewma
+                   - self._draft["acceptance_hint"]) >= 0.1:
+                try:
+                    _autotune_draft_k(
+                        self.num_layers, self.hidden,
+                        self._draft["num_layers"], self._draft["hidden"],
+                        self._accept_ewma)
+                except Exception:
+                    pass
 
     def handoff(self) -> int:
         """Preempt every queued and active stream WITHOUT stopping the
@@ -495,6 +808,8 @@ class DecodeEngine:
         n = 0
         for seq in list(self._pending) + list(self._active):
             self.pool.free(seq.sid)
+            if self._draft_pool is not None:
+                self._draft_pool.free(seq.sid)
             seq.stream._finish(exc)
             n += 1
         self._pending.clear()
@@ -567,11 +882,22 @@ class DecodeEngine:
 
     def snapshot(self) -> dict:
         with self._cv:
-            return {"pending": len(self._pending),
+            snap = {"pending": len(self._pending),
                     "active": len(self._active),
                     "tokens_total": self.metrics.tokens.value,
                     "cold_decode_runs": self.cold_decode_runs(),
+                    "prefix_cache_pages": self.prefix_cache_pages,
                     "kv": self.pool.snapshot()}
+            if self._draft is not None:
+                snap["draft"] = {
+                    "k": self._draft["k"],
+                    "proposed": self.metrics.draft_proposed.value,
+                    "accepted": self.metrics.draft_accepted.value,
+                    "accept_rate_ewma": self._accept_ewma,
+                    "fallbacks": self.metrics.spec_fallbacks.value,
+                    "kv": self._draft_pool.snapshot(),
+                }
+            return snap
 
     # -- engine loop -------------------------------------------------------
     def _loop(self):
@@ -606,10 +932,15 @@ class DecodeEngine:
 
     def _admit(self):
         """Move pending sequences into free decode lanes: allocate KV
-        pages, run bucketed prefill, stream each sequence's first token."""
+        pages (resolving the prompt against the prefix index), run
+        bucketed prefill for the cache misses, stream each prefilled
+        sequence's first token.  Cached sequences go straight to decode
+        lanes — zero prefill steps."""
         batch: List[_Seq] = []
         now = time.monotonic()
-        free_pages = self.pool.free_pages()
+        avail = self.pool.reclaimable_pages()
+        d_avail = (self._draft_pool.free_pages()
+                   if self._draft_pool is not None else None)
         with self._cv:
             while self._pending and \
                     len(self._active) + len(batch) < self.max_lanes:
@@ -621,9 +952,11 @@ class DecodeEngine:
                         "request waited past its TTFT deadline"))
                     continue
                 need = self.pool.pages_for(len(seq.tokens))
-                if need > free_pages:
+                if need > avail or (d_avail is not None and need > d_avail):
                     break  # wait for active lanes to retire/free pages
-                free_pages -= need
+                avail -= need
+                if d_avail is not None:
+                    d_avail -= need
                 self._pending.popleft()
                 batch.append(seq)
             self.metrics.g_pending.set(len(self._pending))
@@ -642,48 +975,143 @@ class DecodeEngine:
                 self._prefill_group(L, seqs[ofs:ofs + cap])
 
     def _prefill_group(self, L: int, seqs: List[_Seq]):
-        bp = self._prefill[L]
-        items = []
-        admitted = []
+        admitted: List[_Seq] = []
         for seq in seqs:
             try:
-                self.pool.alloc(seq.sid, len(seq.tokens))
+                _, cached = self.pool.alloc_prefix(
+                    seq.sid, len(seq.tokens),
+                    tokens=(seq.tokens if self.prefix_cache_pages
+                            else None))
             except KVPoolExhaustedError:
                 # admission raced a concurrent consumer: wait a round
                 with self._cv:
                     self._pending.appendleft(seq)
                 continue
-            buf = np.zeros((L,), self._dtype)
-            buf[:len(seq.tokens)] = seq.tokens
-            items.append({"data": buf})
+            if self._draft_pool is not None:
+                try:
+                    self._draft_pool.alloc(seq.sid, len(seq.tokens))
+                except KVPoolExhaustedError:
+                    self.pool.free(seq.sid)
+                    with self._cv:
+                        self._pending.appendleft(seq)
+                    continue
+            seq.next_pos = cached  # 0 on a miss: full prefill below
+            if cached:
+                seq.stream.cached_prefix_tokens += cached
+                self.metrics.cached_admissions.inc()
             admitted.append(seq)
-        seqs = admitted
-        if not seqs:
+        if not admitted:
             return
-        _, results = bp.forward_batch(items)
-        now_active = []
-        for seq, outs in zip(seqs, results):
-            n = len(seq.tokens)
-            logits = outs[0]  # (L, vocab)
-            for layer in range(self.num_layers):
-                self.pool.write_prefill(seq.sid, layer,
-                                        outs[1 + 2 * layer],
-                                        outs[2 + 2 * layer], n)
-            tok = int(np.argmax(logits[n - 1]))
-            self._emit(seq, tok)
+        # the draft holds no prefix cache: prefill EVERY admitted
+        # sequence through the draft model so proposals can start from
+        # the first decode iteration
+        if self._draft is not None:
+            dbp = self._draft_prefill[L]
+            items = []
+            for seq in admitted:
+                buf = np.zeros((L,), self._dtype)
+                buf[:len(seq.tokens)] = seq.tokens
+                items.append({"data": buf})
+            _, results = dbp.forward_batch(items)
+            for seq, outs in zip(admitted, results):
+                n = len(seq.tokens)
+                for layer in range(self._draft["num_layers"]):
+                    self._draft_pool.write_prefill(
+                        seq.sid, layer, outs[1 + 2 * layer],
+                        outs[2 + 2 * layer], n)
+                seq.draft_pos = n
+        misses = [s for s in admitted if s.next_pos == 0]
+        if misses:
+            bp = self._prefill[L]
+            items = []
+            for seq in misses:
+                buf = np.zeros((L,), self._dtype)
+                buf[:len(seq.tokens)] = seq.tokens
+                items.append({"data": buf})
+            _, results = bp.forward_batch(items)
+            for seq, outs in zip(misses, results):
+                n = len(seq.tokens)
+                logits = outs[0]  # (L, vocab)
+                for layer in range(self.num_layers):
+                    self.pool.write_prefill(seq.sid, layer,
+                                            outs[1 + 2 * layer],
+                                            outs[2 + 2 * layer], n)
+                seq.stream.prefill_tokens += n
+                seq.next_pos = n
+                if self.prefix_cache_pages:
+                    self.pool.register_prefix(seq.sid, seq.tokens[:n])
+                tok = int(np.argmax(logits[n - 1]))
+                self._emit(seq, tok)
+        if self.prefix_cache_pages:
+            self._catchup_group([s for s in admitted if s not in misses])
+        for seq in admitted:
             seq.admitted_at = time.monotonic()
-            now_active.append(seq)
         with self._cv:
-            self._active.extend(s for s in now_active
+            self._active.extend(s for s in admitted
                                 if not s.stream.done)
-            self.metrics.admitted.inc(len(now_active))
+            self.metrics.admitted.inc(len(admitted))
             self.metrics.g_active.set(len(self._active))
+
+    def _catchup_group(self, seqs: List[_Seq]):
+        """Batch-walk the KNOWN suffix of prefix hits through the
+        windowed catch-up executable — ``catchup_width`` positions
+        per forward instead of one per decode iteration — feeding
+        THROUGH the final prompt position and emitting the first
+        generated token from the last slot's logits.  A cached
+        admission therefore reaches its first token inside admission,
+        in ``ceil(suffix / catchup_width)`` forwards, with no separate
+        decode step: TTFT stays one engine iteration regardless of how
+        far short of the prompt the index's page-granular match fell."""
+        pending = [s for s in seqs
+                   if 0 < s.next_pos < len(s.tokens)]
+        if not pending or not self._catchup:
+            return
+        W = self._catchup_width
+        while pending:
+            b = self._lane_bucket_for(len(pending))
+            self._note_lane_bucket(b)
+            pred = self._catchup[b]
+            data = np.zeros((b, W), self._dtype)
+            # pads park in the scratch page's last slot (zero table row)
+            positions = np.full((b, W), self.max_seq_len - 1,
+                                dtype=self._dtype)
+            table = np.zeros((b, self.max_pages), self._dtype)
+            spans = []
+            for i, seq in enumerate(pending):
+                # the cursor's page can still be prefix-indexed/shared
+                self.pool.ensure_writable(seq.sid, seq.next_pos)
+                span = min(W, len(seq.tokens) - seq.next_pos)
+                data[i, :span] = seq.tokens[seq.next_pos:
+                                            seq.next_pos + span]
+                positions[i, :span] = np.arange(seq.next_pos,
+                                                seq.next_pos + span)
+                table[i] = self.pool.page_table_row(seq.sid,
+                                                    self.max_pages)
+                spans.append(span)
+            outs = self._run_lanes(pred, self.num_layers, self.pool,
+                                   data, positions, table)
+            logits = outs[0].reshape(b, W, -1)  # (lanes, width, vocab)
+            nxt = []
+            for i, (seq, span) in enumerate(zip(pending, spans)):
+                seq.iters += 1
+                seq.next_pos += span
+                self.pool.register_prefix(seq.sid,
+                                          seq.tokens[:seq.next_pos])
+                if seq.next_pos >= len(seq.tokens):
+                    # crossed into generation: the last fed slot's
+                    # logits seed the stream's first token
+                    self._emit(seq, int(np.argmax(logits[i, span - 1])))
+                else:
+                    nxt.append(seq)
+            pending = nxt
 
     def _emit(self, seq: _Seq, tok: int):
         """Stream one generated token; retires the sequence when it hit
         its budget or EOS.  Returns True when the sequence retired."""
         first = not seq.stream.tokens
         gap = seq.stream._emit(tok)
+        if first:
+            seq.stream.ttft_iters = seq.iters
         seq.tokens.append(tok)
         seq.gen_count += 1
         self.metrics.tokens.inc()
@@ -696,15 +1124,24 @@ class DecodeEngine:
 
     def _retire(self, seq: _Seq):
         faults.fire("generation.engine.retire")
+        if self.prefix_cache_pages:
+            # publish the finished transcript's complete pages before
+            # releasing them: a refcount-0 indexed page is retained as
+            # cache, so the next request sharing this prefix hits
+            self.pool.register_prefix(seq.sid, seq.tokens[:seq.next_pos])
         self.pool.free(seq.sid)
+        if self._draft_pool is not None:
+            self._draft_pool.free(seq.sid)
         seq.stream._finish(None)
         self.metrics.retired.inc()
 
     def _preempt_one(self, exclude: Optional[_Seq] = None) -> bool:
         """Free the youngest active lane's pages and push the sequence
-        back to the FRONT of the pending queue for re-prefill of
+        back to the FRONT of the pending queue for re-admission of
         prompt + generated-so-far (greedy decode is deterministic, so
-        its stream continues without a hiccup)."""
+        its stream continues without a hiccup).  Its complete pages are
+        published to the prefix index first, so with caching enabled
+        the re-admission is a prefix HIT instead of a full re-prefill."""
         with self._cv:
             victims = [s for s in self._active if s is not exclude]
             if not victims:
@@ -716,7 +1153,14 @@ class DecodeEngine:
             self._pending.appendleft(victim)
             self.metrics.g_active.set(len(self._active))
             self.metrics.g_pending.set(len(self._pending))
+        if self.prefix_cache_pages:
+            self.pool.register_prefix(victim.sid,
+                                      victim.tokens[:victim.next_pos])
         self.pool.free(victim.sid)
+        if self._draft_pool is not None:
+            self._draft_pool.free(victim.sid)
+        victim.next_pos = 0
+        victim.draft_pos = 0
         self.metrics.preempted.inc()
         _telemetry.log_event("gen_preempt", sid=victim.sid,
                              tokens=len(victim.tokens))
@@ -729,17 +1173,43 @@ class DecodeEngine:
         raise MXNetError("%d active lanes exceed largest bucket %d"
                          % (n, self.lane_buckets[-1]))
 
+    def _note_lane_bucket(self, b: int):
+        if b in self.warmed_lane_buckets:
+            return
+        self.decode_cold_runs += 1
+        self.metrics.cold_steps.inc()
+        self.warmed_lane_buckets.add(b)
+        if b not in self._warned_lane_buckets:
+            self._warned_lane_buckets.add(b)
+            logging.warning(
+                "generation: decode step hit never-warmed lane bucket "
+                "%d post-warmup (fresh XLA compile on the serving "
+                "path) — add it to lane_buckets/warmup", b)
+            _telemetry.log_event("gen_decode_cold_bucket", lanes=b)
+
     def _decode_step(self):
-        """One continuous-batching iteration: every active lane advances
-        one token through the fixed-shape paged-attention executable."""
+        """One continuous-batching iteration: grow every lane's KV
+        allocation for the positions about to be written (pool
+        exhaustion preempts the youngest other lane), copy-on-write any
+        shared page under the feed cursor, then advance every lane —
+        one token via the decode executable, or up to K+1 via the
+        draft/verify speculative pass."""
         faults.fire("generation.engine.step")
-        # grow each lane's KV allocation for the token about to be
-        # written; pool exhaustion preempts the youngest other lane
+        width = self._verify_width
         for seq in list(self._active):
             # an earlier lane's extend may have preempted this one already
             while seq in self._active:
                 try:
-                    self.pool.extend(seq.sid, len(seq.tokens))
+                    tgt = min(seq.next_pos + width, seq.limit,
+                              self.max_seq_len)
+                    self.pool.extend(seq.sid, tgt)
+                    if self.prefix_cache_pages:
+                        # the page under the cursor may be shared (cached
+                        # admission) or still prefix-indexed: split it
+                        # before this iteration writes K/V there
+                        self.pool.ensure_writable(seq.sid, seq.next_pos)
+                    if self._draft_pool is not None:
+                        self._draft_pool.extend(seq.sid, tgt)
                     break
                 except KVPoolExhaustedError:
                     if not self._preempt_one(exclude=seq):
@@ -747,47 +1217,223 @@ class DecodeEngine:
         active = list(self._active)
         if not active:
             return
+        if self._draft is not None:
+            self._spec_step(active)
+        else:
+            self._plain_step(active)
+
+    def _run_lanes(self, pred, n_layers, pool, data, positions, table):
+        """Bind one lane-bucket executable, run it, write the pool
+        planes back, return the raw outputs."""
+        pred.set_input("data", data)
+        pred.set_input("positions", positions)
+        pred.set_input("page_table", table)
+        for i in range(n_layers):
+            pred.set_input("layer%d_k_pool" % i, pool.k_pools[i])
+            pred.set_input("layer%d_v_pool" % i, pool.v_pools[i])
+        pred._exec.forward(is_train=False)
+        outs = [o.asnumpy() for o in pred.get_outputs()]
+        n_logits = len(outs) - 2 * n_layers
+        for i in range(n_layers):
+            np.copyto(pool.k_pools[i], outs[n_logits + 2 * i])
+            np.copyto(pool.v_pools[i], outs[n_logits + 2 * i + 1])
+        return outs
+
+    def _plain_step(self, active: List[_Seq]):
+        """Advance every active lane one position through the decode
+        executable: feed ``tokens[next_pos]`` at ``next_pos``, emit the
+        argmax only when the cursor crosses into generation (a lane
+        re-walking a known suffix — partial cache hit, re-admitted
+        preemptee — just materializes K/V silently)."""
         b = self._lane_bucket_for(len(active))
-        if b not in self.warmed_lane_buckets:
-            self.decode_cold_runs += 1
-            self.metrics.cold_steps.inc()
-            self.warmed_lane_buckets.add(b)
-            if b not in self._warned_lane_buckets:
-                self._warned_lane_buckets.add(b)
-                logging.warning(
-                    "generation: decode step hit never-warmed lane bucket "
-                    "%d post-warmup (fresh XLA compile on the serving "
-                    "path) — add it to lane_buckets/warmup", b)
-                _telemetry.log_event("gen_decode_cold_bucket", lanes=b)
+        self._note_lane_bucket(b)
         pred = self._decode[b]
         data = np.zeros((b,), self._dtype)
         positions = np.zeros((b,), self._dtype)
         table = np.zeros((b, self.max_pages), self._dtype)
         for i, seq in enumerate(active):
-            data[i] = seq.tokens[-1]
-            positions[i] = len(seq.tokens) - 1  # slot the new K/V lands in
+            data[i] = seq.tokens[seq.next_pos]
+            positions[i] = seq.next_pos  # slot the new K/V lands in
             table[i] = self.pool.page_table_row(seq.sid, self.max_pages)
-        pred.set_input("data", data)
-        pred.set_input("positions", positions)
-        pred.set_input("page_table", table)
-        for i in range(self.num_layers):
-            pred.set_input("layer%d_k_pool" % i, self.pool.k_pools[i])
-            pred.set_input("layer%d_v_pool" % i, self.pool.v_pools[i])
-        pred._exec.forward(is_train=False)
-        outs = [o.asnumpy() for o in pred.get_outputs()]
+        outs = self._run_lanes(pred, self.num_layers, self.pool,
+                               data, positions, table)
         logits = outs[0]
-        for i in range(self.num_layers):
-            np.copyto(self.pool.k_pools[i], outs[1 + 2 * i])
-            np.copyto(self.pool.v_pools[i], outs[2 + 2 * i])
         self.metrics.steps.inc()
         retired = []
         for i, seq in enumerate(active):
-            if self._emit(seq, int(np.argmax(logits[i]))):
-                retired.append(seq)
-        if retired:
-            with self._cv:
-                for seq in retired:
-                    if seq in self._active:
-                        self._active.remove(seq)
-                self.metrics.g_active.set(len(self._active))
-                self._cv.notify_all()
+            seq.iters += 1
+            seq.next_pos += 1
+            if self.prefix_cache_pages:
+                self.pool.register_prefix(seq.sid,
+                                          seq.tokens[:seq.next_pos])
+            if seq.next_pos >= len(seq.tokens):
+                if self._emit(seq, int(np.argmax(logits[i]))):
+                    retired.append(seq)
+        self._drop_retired(retired)
+
+    def _spec_step(self, active: List[_Seq]):
+        """One speculative iteration: draft K proposals per steady lane,
+        then ONE windowed target verify pass scores feed slots
+        ``[tokens[next_pos], d_1 .. d_K]`` at positions ``next_pos ..
+        next_pos+K`` (teacher forcing — every feed token is known before
+        the call, so the graph is the same single causal pass as
+        catch-up, not K+1 chained decode blocks).  Greedy acceptance
+        walks the slots in order, keeping every emitted argmax whose
+        following draft feed matches — the emitted tokens are the
+        TARGET's own argmaxes over the same paged K/V a plain decode
+        would read, and the spec-parity tests assert transcript equality
+        against non-speculative greedy for every K.  A fault at
+        ``generation.draft.verify`` degrades THIS iteration to a plain
+        single-token step instead of failing any stream."""
+        width = self._verify_width
+        b = self._lane_bucket_for(len(active))
+        self._note_lane_bucket(b)
+        try:
+            faults.fire("generation.draft.verify")
+        except Exception:
+            self.metrics.spec_fallbacks.inc()
+            self._plain_step(active)
+            return
+        proposals = self._draft_propose(active, b)
+        vpred = self._verify[b]
+        data = np.zeros((b, width), self._dtype)
+        # pad slots park at (token 0, position max_seq_len-1): with a
+        # zero page-table row beyond the lane's allocation the write
+        # lands in scratch page 0, and no live position ever attends it
+        positions = np.full((b, width), self.max_seq_len - 1, self._dtype)
+        table = np.zeros((b, self.max_pages), self._dtype)
+        lane_width: Dict[object, int] = {}
+        for i, seq in enumerate(active):
+            table[i] = self.pool.page_table_row(seq.sid, self.max_pages)
+            drafts = proposals.get(seq.sid, [])
+            lw = 0
+            for w in range(width):
+                p = seq.next_pos + w
+                if p >= min(seq.limit, self.max_seq_len):
+                    break
+                if p < len(seq.tokens):
+                    tok = seq.tokens[p]
+                else:
+                    j = w - (len(seq.tokens) - seq.next_pos)
+                    if j < 0 or j >= len(drafts):
+                        break
+                    tok = drafts[j]
+                data[i, w] = tok
+                positions[i, w] = p
+                lw += 1
+            lane_width[seq.sid] = lw
+        outs = self._run_lanes(vpred, self.num_layers, self.pool,
+                               data, positions, table)
+        logits = outs[0].reshape(b, width, -1)
+        self.metrics.steps.inc()
+        retired = []
+        for i, seq in enumerate(active):
+            seq.iters += 1
+            lw = lane_width[seq.sid]
+            n_drafted = max(0, lw - (len(seq.tokens) - seq.next_pos))
+            start = seq.next_pos
+            emits = 0
+            for w in range(lw):
+                g = int(np.argmax(logits[i, w]))
+                seq.next_pos = start + w + 1
+                if seq.next_pos < len(seq.tokens):
+                    continue  # known-suffix slot: K/V only, no emission
+                emits += 1
+                if self._emit(seq, g):
+                    retired.append(seq)
+                    break
+                if w + 1 < lw and int(data[i, w + 1]) != g:
+                    break  # draft diverged: discard the rest
+            if self.prefix_cache_pages:
+                self.pool.register_prefix(seq.sid,
+                                          seq.tokens[:seq.next_pos])
+            # the draft pool holds accepted-token K/V below next_pos and
+            # rejected junk above it: snap the cursor back so the next
+            # sync round re-feeds only what the target actually kept
+            seq.draft_pos = seq.next_pos
+            if n_drafted:
+                accepted = max(0, emits - 1)
+                self.metrics.draft_proposed.inc(n_drafted)
+                self.metrics.draft_accepted.inc(accepted)
+                rate = accepted / float(n_drafted)
+                st = seq.stream
+                st.draft_proposed += n_drafted
+                st.draft_accepted += accepted
+                st.accept_rate = (rate if st.accept_rate is None
+                                  else 0.8 * st.accept_rate + 0.2 * rate)
+                self._accept_ewma = (rate if self._accept_ewma is None
+                                     else 0.8 * self._accept_ewma
+                                     + 0.2 * rate)
+                self.metrics.g_accept.set(self._accept_ewma)
+        self._drop_retired(retired)
+
+    def _draft_propose(self, active: List[_Seq], b: int) -> Dict:
+        """Run the draft model: first catch its pool up to each lane's
+        feed cursor (re-feeding accepted tokens its last rejected run
+        clobbered), then K batched rounds of chained greedy proposals
+        for every steady lane.  Returns {sid: [d_1 .. d_K]}."""
+        k = self._verify_width - 1
+        pred = self._draft_decode[b]
+        dl = self._draft["num_layers"]
+        rows = {s.sid: self._draft_pool.page_table_row(s.sid,
+                                                       self.max_pages)
+                for s in active}
+        while True:
+            lag = [s for s in active if s.draft_pos < s.next_pos]
+            if not lag:
+                break
+            data = np.zeros((b,), self._dtype)
+            positions = np.full((b,), self.max_seq_len - 1, self._dtype)
+            table = np.zeros((b, self.max_pages), self._dtype)
+            for i, seq in enumerate(active):
+                if seq.draft_pos < seq.next_pos:
+                    data[i] = seq.tokens[seq.draft_pos]
+                    positions[i] = seq.draft_pos
+                    table[i] = rows[seq.sid]
+            self._run_lanes(pred, dl, self._draft_pool,
+                            data, positions, table)
+            for seq in lag:
+                seq.draft_pos += 1
+        proposals: Dict[object, List[int]] = {}
+        feed: Dict[object, int] = {}
+        for seq in active:
+            if seq.next_pos == len(seq.tokens) - 1:
+                proposals[seq.sid] = []
+                feed[seq.sid] = seq.tokens[seq.next_pos]
+        if not proposals:
+            return proposals
+        for r in range(k):
+            data = np.zeros((b,), self._dtype)
+            positions = np.full((b,), self.max_seq_len - 1, self._dtype)
+            table = np.zeros((b, self.max_pages), self._dtype)
+            live = []
+            for i, seq in enumerate(active):
+                if seq.sid not in proposals:
+                    continue
+                p = seq.next_pos + r
+                if p >= min(seq.limit, self.max_seq_len) - 1:
+                    continue  # no use drafting past the hard stop
+                data[i] = feed[seq.sid]
+                positions[i] = p
+                table[i] = rows[seq.sid]
+                live.append((i, seq))
+            if not live:
+                break
+            outs = self._run_lanes(pred, dl, self._draft_pool,
+                                   data, positions, table)
+            logits = outs[0]
+            for i, seq in live:
+                d = int(np.argmax(logits[i]))
+                proposals[seq.sid].append(d)
+                feed[seq.sid] = d
+        return proposals
+
+    def _drop_retired(self, retired: List[_Seq]):
+        if not retired:
+            return
+        with self._cv:
+            for seq in retired:
+                if seq in self._active:
+                    self._active.remove(seq)
+            self.metrics.g_active.set(len(self._active))
+            self._cv.notify_all()

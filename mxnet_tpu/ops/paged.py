@@ -60,6 +60,81 @@ def _paged_infer(attrs, shapes):
     return shapes, [q, k_pool, v_pool], []
 
 
+@register("_contrib_PagedAttentionWindow",
+          inputs=("query", "key", "value", "k_pool", "v_pool",
+                  "page_table", "positions"),
+          params={"page_size": Param(int, required=True),
+                  "scale": Param("float-or-none", None)},
+          num_outputs=3, infer_shape=_paged_infer,
+          no_grad_inputs=("page_table", "positions"),
+          output_names=lambda attrs: ["out", "k_pool_out", "v_pool_out"],
+          hint="pagedattentionwindow")
+def _paged_attention_window(opctx, attrs, q, k_new, v_new, k_pool, v_pool,
+                            page_table, positions):
+    """``width`` KNOWN tokens per lane in ONE causal pass over paged KV.
+
+    The sequential decode chain is only necessary when each token must
+    be *discovered* from the previous logits.  When the whole window is
+    known up front — a prefix-cache catch-up walking a prompt suffix, a
+    re-admitted preemptee re-materializing its transcript — teacher
+    forcing applies: write all ``width`` new K/V slots, gather each
+    lane's history ONCE, and attend all ``width`` queries under a
+    per-query causal mask.  Same numerics family as the chained
+    construction at a fraction of the gathers (2 per layer instead of
+    2 per layer per token) and with every projection batched over
+    ``lanes * width`` rows instead of ``lanes``.
+
+    Shapes (all static):
+      q, k_new, v_new : (lanes * width, heads, head_dim)
+      k_pool, v_pool  : (num_pages, page_size, heads, head_dim)
+      page_table      : (lanes, max_pages)
+      positions       : (lanes, width) absolute position per window slot
+                        (pad slots point at the scratch page, as decode)
+    Returns (att_out (lanes * width, heads, head_dim), k_pool_out,
+    v_pool_out).
+    """
+    import jax.numpy as jnp
+
+    ps = int(attrs["page_size"])
+    lanes, width = positions.shape
+    heads, hd = q.shape[-2], q.shape[-1]
+    num_pages = k_pool.shape[0]
+    max_pages = page_table.shape[1]
+    scale = attrs.get("scale")
+    scale = (1.0 / np.sqrt(hd)) if scale is None else float(scale)
+
+    pt = page_table.astype(jnp.int32)
+    pos = positions.astype(jnp.int32)  # (lanes, width)
+
+    # -- write: the whole window's K/V into each lane's slots ------------
+    flat_k = k_pool.reshape(num_pages * ps, heads, hd)
+    flat_v = v_pool.reshape(num_pages * ps, heads, hd)
+    page_idx = jnp.take_along_axis(pt, pos // ps, axis=1)  # (lanes, width)
+    slot = (page_idx * ps + pos % ps).reshape(-1)
+    flat_k = flat_k.at[slot].set(k_new.astype(flat_k.dtype))
+    flat_v = flat_v.at[slot].set(v_new.astype(flat_v.dtype))
+
+    # -- gather ONCE: each lane's full history, in token order -----------
+    ctx_idx = (pt[:, :, None] * ps
+               + jnp.arange(ps, dtype=jnp.int32)[None, None, :])
+    ctx_idx = ctx_idx.reshape(lanes, max_pages * ps)
+    keys = flat_k[ctx_idx]    # (lanes, T, heads, hd)
+    vals = flat_v[ctx_idx]
+
+    # -- causal masked attention, all width queries at once --------------
+    qw = q.reshape(lanes, width, heads, hd)
+    s = jnp.einsum("lwhd,lthd->lwht", qw, keys).astype(jnp.float32) * scale
+    valid = (jnp.arange(max_pages * ps, dtype=jnp.int32)[None, None, :]
+             <= pos[:, :, None])  # (lanes, width, T)
+    s = jnp.where(valid[:, :, None, :], s, _NEG)
+    p = jnp.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    out = jnp.einsum("lwht,lthd->lwhd", p, vals).astype(q.dtype)
+    return (out.reshape(lanes * width, heads, hd),
+            flat_k.reshape(num_pages, ps, heads, hd),
+            flat_v.reshape(num_pages, ps, heads, hd))
+
+
 @register("_contrib_PagedAttention",
           inputs=("query", "key", "value", "k_pool", "v_pool",
                   "page_table", "positions"),

@@ -287,7 +287,21 @@ class InferenceServer:
             "dtype": self._dtype.name,
         }
         if self._generator_spec is not None:
-            warmup["generator"] = dict(self._generator_spec)
+            gen_spec = dict(self._generator_spec)
+            draft = gen_spec.get("draft")
+            if draft and not isinstance(draft.get("params"), str):
+                # the warmup manifest is JSON: in-memory draft weights
+                # must travel as a sibling .params file, path-referenced
+                from .. import ndarray as nd
+
+                draft = dict(draft)
+                dpath = "%s-%04d.draft.params" % (prefix, int(epoch))
+                nd.save(dpath, {k: v if isinstance(v, nd.NDArray)
+                                else nd.array(np.asarray(v))
+                                for k, v in draft["params"].items()})
+                draft["params"] = dpath
+                gen_spec["draft"] = draft
+            warmup["generator"] = gen_spec
         return _save(prefix, epoch, entries, warmup=warmup)
 
     # -- lifecycle --------------------------------------------------------

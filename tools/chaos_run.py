@@ -762,6 +762,181 @@ def run_decode_storm(seed, timeout=120.0, replicas=2, load_threads=3,
     return ok
 
 
+def run_prefix_storm(seed, timeout=120.0, replicas=2, load_threads=3,
+                     streams_per_thread=5):
+    """Prefix-cache/speculation probe, in-process: every client hammers
+    prompts sharing one hot system-style prefix against a Router whose
+    replicas run the copy-on-write prefix cache AND a draft model,
+    while the fault plane fails prefix lookups and draft verifies
+    (``generation.prefix.lookup`` / ``generation.draft.verify`` ioerr)
+    and one replica is hard-killed mid-storm.  A lookup fault must
+    degrade to a cache miss and a verify fault to a plain decode step —
+    never to a wrong token: greedy decode is deterministic, so every
+    transcript must be bit-identical to an uncached, non-speculative
+    reference engine.  Passes when zero streams failed, every
+    transcript matched, the cache actually served hits under the fault
+    storm, survivors did zero post-warmup compiles, and — after
+    shutdown — every replica's pool refcounts returned to zero (no
+    leaked shared pages)."""
+    import threading
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import faults as mx_faults
+    from mxnet_tpu import serving
+
+    V, layers, heads, hid, S = 64, 2, 2, 32, 32
+    rng = np.random.RandomState(seed)
+    net = mx.models.get_transformer_lm(vocab_size=V, num_layers=layers,
+                                       num_heads=heads, hidden=hid,
+                                       seq_len=S)
+    arg_shapes, _, _ = net.infer_shape(data=(1, S), softmax_label=(1, S))
+    params = {
+        name: mx.nd.array(rng.randn(*shp).astype(np.float32) * 0.05)
+        for name, shp in zip(net.list_arguments(), arg_shapes)
+        if name not in ("data", "softmax_label")}
+    spec = dict(vocab_size=V, num_layers=layers, num_heads=heads,
+                hidden=hid, max_seq_len=S, lane_buckets=(1, 2, 4),
+                page_size=4, num_pages=40, prefill_len_buckets=(8, 16, 32))
+    gen_spec = dict(spec, prefix_cache_pages=12,
+                    draft={"params": params, "num_layers": layers,
+                           "num_heads": heads, "hidden": hid, "k": 2})
+
+    victim_idx = seed % replicas
+    kill_after = 4 + seed % 5
+    print("chaos_run: prefix-storm seed %d: victim r%d dies after %d "
+          "streams; prefix lookups and draft verifies fault at 25%%"
+          % (seed, victim_idx, kill_after), file=sys.stderr, flush=True)
+
+    # one hot shared prefix, per-prompt unique tails — heavy page
+    # sharing plus COW splits the moment the tails diverge
+    shared = [int(t) for t in rng.randint(0, V, size=12)]
+    prompts = []
+    for i in range(8):
+        tail = [int(t) for t in rng.randint(0, V, size=int(
+            rng.randint(0, 7)))]
+        prompts.append((shared + tail, 4 + int(rng.randint(0, 5))))
+
+    # greedy reference: NO cache, NO draft, NO faults — THE transcript
+    ref_engine = mx.generation.DecodeEngine(params, **spec)
+    reference = {i: ref_engine.generate(p, n)
+                 for i, (p, n) in enumerate(prompts)}
+    ref_engine.stop()
+
+    srvs = [serving.InferenceServer(
+        net, params, {"data": (4, S), "softmax_label": (4, S)},
+        max_wait_us=1000, generator_spec=dict(gen_spec))
+        for _ in range(replicas)]
+    engines = [s._generator for s in srvs]
+    router = serving.Router(srvs, seed=seed, retries=3)
+
+    stop_evt = threading.Event()
+    failures = []
+    mismatches = []
+    completed = [0]
+    lock = threading.Lock()
+
+    def load(tid):
+        i = tid
+        while not stop_evt.is_set():
+            pi = i % len(prompts)
+            prompt, max_new = prompts[pi]
+            try:
+                toks = list(router.generate(prompt, max_new,
+                                            request_id="pstorm-%d-%d"
+                                            % (tid, i)))
+                if toks != reference[pi]:
+                    with lock:
+                        mismatches.append((pi, toks, reference[pi]))
+                with lock:
+                    completed[0] += 1
+            except Exception as exc:
+                with lock:
+                    failures.append(repr(exc))
+            i += load_threads
+
+    deadline = time.monotonic() + timeout
+    ok = True
+    threads = [threading.Thread(target=load, args=(t,), daemon=True)
+               for t in range(load_threads)]
+    fault_spec = ("generation.prefix.lookup:ioerr=0.25;"
+                  "generation.draft.verify:ioerr=0.25")
+    try:
+        with mx_faults.inject(fault_spec, seed=seed):
+            for t in threads:
+                t.start()
+            while time.monotonic() < deadline and \
+                    completed[0] < kill_after:
+                time.sleep(0.02)
+            print("chaos_run: killing replica r%d mid-storm (%d streams "
+                  "done)" % (victim_idx, completed[0]),
+                  file=sys.stderr, flush=True)
+            srvs[victim_idx].stop(drain=False)
+            target = completed[0] + load_threads * streams_per_thread
+            while time.monotonic() < deadline and completed[0] < target:
+                time.sleep(0.05)
+            stop_evt.set()
+            for t in threads:
+                t.join(timeout=max(1.0, deadline - time.monotonic()))
+    finally:
+        stop_evt.set()
+        router.close(stop_backends=True)
+
+    if failures:
+        print("chaos_run: %d streams failed (first: %s)"
+              % (len(failures), failures[:3]), file=sys.stderr, flush=True)
+        ok = False
+    if mismatches:
+        pi, got, want = mismatches[0]
+        print("chaos_run: %d transcript mismatches (prompt %d: got %s "
+              "want %s) — a degraded cache/draft path changed tokens"
+              % (len(mismatches), pi, got, want),
+              file=sys.stderr, flush=True)
+        ok = False
+    if completed[0] < kill_after + 1:
+        print("chaos_run: storm too short (%d streams) to cover the kill"
+              % completed[0], file=sys.stderr, flush=True)
+        ok = False
+    snaps = [e.pool.snapshot() for e in engines]
+    hits = sum(s["prefix_hits"] for s in snaps)
+    if not hits:
+        print("chaos_run: prefix cache never hit — the storm did not "
+              "exercise sharing", file=sys.stderr, flush=True)
+        ok = False
+    leaked = {i: s["total_refcount"] for i, s in enumerate(snaps)
+              if s["total_refcount"]}
+    dleaked = {i: e._draft_pool.total_refcount()
+               for i, e in enumerate(engines)
+               if e._draft_pool is not None
+               and e._draft_pool.total_refcount()}
+    if leaked or dleaked:
+        print("chaos_run: leaked shared pages after shutdown "
+              "(target %s draft %s)" % (leaked, dleaked),
+              file=sys.stderr, flush=True)
+        ok = False
+    cold = sum(engines[i].cold_decode_runs()
+               for i in range(replicas) if i != victim_idx)
+    if cold:
+        print("chaos_run: %d post-warmup decode recompiles on survivors"
+              % cold, file=sys.stderr, flush=True)
+        ok = False
+    if ok:
+        fb = sum(e.metrics.spec_fallbacks.value for e in engines)
+        cow = sum(s["cow_copies"] for s in snaps)
+        print("chaos_run: %d streams completed, 0 failed, 0 mismatches; "
+              "%d prefix hits, %d COW splits, %d verify-fault fallbacks; "
+              "refcounts drained to 0"
+              % (completed[0], hits, cow, fb),
+              file=sys.stderr, flush=True)
+    return ok
+
+
 def run_sparse_replay(seed, timeout=120.0):
     """Exactly-once probe for the sparse wire: one row-sparse push whose
     ACK the server drops (``kv.server.send:drop=1@#1``).  The client sees
@@ -1578,6 +1753,7 @@ _SCENARIOS = {"membership-churn": run_membership_churn,
               "serving-failover": run_serving_failover,
               "flash-crowd": run_flash_crowd,
               "decode-storm": run_decode_storm,
+              "prefix-storm": run_prefix_storm,
               "sparse-replay": run_sparse_replay,
               "sdc-rollback": run_sdc_rollback,
               "tenant-storm": run_tenant_storm,
